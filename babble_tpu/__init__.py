@@ -1,0 +1,25 @@
+"""babble_tpu — a TPU-native BFT consensus framework.
+
+A brand-new implementation of leaderless Byzantine-fault-tolerant transaction
+ordering via Hashgraph virtual voting (Baird 2016), with the capability
+surface of the reference Go implementation (sikoba/babble):
+
+- gossip-about-gossip networking (in-memory / TCP transports),
+- a blockchain projection with signed blocks,
+- dynamic validator membership (join/leave through consensus),
+- fast-sync from frame checkpoints and app snapshots,
+- a language-agnostic app proxy (in-memory and socket),
+- an HTTP observability service and a CLI.
+
+Unlike the pure-Go reference, the per-event compute — batched secp256k1
+signature verification and the DAG round/fame/ordering pipeline — is
+re-expressed as JAX/XLA kernels (see `babble_tpu.ops`), sharded over TPU
+meshes with `shard_map` (see `babble_tpu.parallel`). The gossip layer is the
+DCN control plane feeding the TPU as a consensus coprocessor.
+
+Reference layer map: SURVEY.md §1; component inventory: SURVEY.md §2.
+"""
+
+from babble_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,26 @@
+"""Common utilities (reference: src/common/).
+
+LRU cache, rolling indexes, typed store errors, trilean logic, median.
+"""
+
+from babble_tpu.common.errors import (
+    StoreError,
+    StoreErrorKind,
+    is_store_err,
+)
+from babble_tpu.common.lru import LRU
+from babble_tpu.common.rolling_index import RollingIndex
+from babble_tpu.common.rolling_index_map import RollingIndexMap
+from babble_tpu.common.trilean import Trilean
+from babble_tpu.common.utils import median_int
+
+__all__ = [
+    "LRU",
+    "RollingIndex",
+    "RollingIndexMap",
+    "StoreError",
+    "StoreErrorKind",
+    "Trilean",
+    "is_store_err",
+    "median_int",
+]

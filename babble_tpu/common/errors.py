@@ -1,0 +1,34 @@
+"""Typed store errors (reference: src/common/store_errors.go:8-41).
+
+The consensus engine distinguishes *why* a lookup failed: a key that was
+never set (KEY_NOT_FOUND) is handled differently from one that was evicted
+from a rolling window (TOO_LATE) or an out-of-order append (SKIPPED_INDEX).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StoreErrorKind(enum.Enum):
+    KEY_NOT_FOUND = "not found"
+    TOO_LATE = "too late"
+    SKIPPED_INDEX = "skipped index"
+    UNKNOWN_PARTICIPANT = "unknown participant"
+    EMPTY = "empty"
+    KEY_ALREADY_EXISTS = "key already exists"
+
+
+class StoreError(Exception):
+    """Error with a typed kind, so callers can branch on the failure mode."""
+
+    def __init__(self, resource: str, kind: StoreErrorKind, key: str = ""):
+        self.resource = resource
+        self.kind = kind
+        self.key = key
+        super().__init__(f"{resource}, {key}, {kind.value}")
+
+
+def is_store_err(err: object, kind: StoreErrorKind) -> bool:
+    """True iff err is a StoreError of the given kind (reference: store_errors.go:36-41)."""
+    return isinstance(err, StoreError) and err.kind == kind

@@ -1,0 +1,68 @@
+"""LRU cache (reference: src/common/lru.go).
+
+A small, deterministic LRU with an optional eviction callback. Backed by an
+OrderedDict; most-recently-used entries live at the end.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Iterator, Optional
+
+
+class LRU:
+    def __init__(self, size: int, evict_callback: Optional[Callable[[Any, Any], None]] = None):
+        if size <= 0:
+            raise ValueError("LRU size must be positive")
+        self.size = size
+        self._evict = evict_callback
+        self._items: OrderedDict[Any, Any] = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._items
+
+    def get(self, key: Any) -> tuple[Any, bool]:
+        """Return (value, ok); refreshes recency on hit."""
+        if key not in self._items:
+            return None, False
+        self._items.move_to_end(key)
+        return self._items[key], True
+
+    def add(self, key: Any, value: Any) -> bool:
+        """Insert/update; returns True if an eviction occurred."""
+        if key in self._items:
+            self._items.move_to_end(key)
+            self._items[key] = value
+            return False
+        self._items[key] = value
+        if len(self._items) > self.size:
+            old_key, old_val = self._items.popitem(last=False)
+            if self._evict is not None:
+                self._evict(old_key, old_val)
+            return True
+        return False
+
+    def peek(self, key: Any) -> tuple[Any, bool]:
+        """Like get, without refreshing recency."""
+        if key not in self._items:
+            return None, False
+        return self._items[key], True
+
+    def remove(self, key: Any) -> bool:
+        if key in self._items:
+            del self._items[key]
+            return True
+        return False
+
+    def keys(self) -> Iterator[Any]:
+        """Keys oldest → newest."""
+        return iter(list(self._items.keys()))
+
+    def purge(self) -> None:
+        if self._evict is not None:
+            for k, v in self._items.items():
+                self._evict(k, v)
+        self._items.clear()

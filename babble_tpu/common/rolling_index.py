@@ -1,0 +1,62 @@
+"""RollingIndex — bounded FIFO with strict sequential indexes.
+
+Reference: src/common/rolling_index.go:8-110. Items are appended at
+consecutive integer indexes; when the buffer exceeds 2*size it evicts the
+oldest half. Reads below the retained window raise TOO_LATE; reads beyond
+the head raise KEY_NOT_FOUND; non-sequential appends raise SKIPPED_INDEX.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind
+
+
+class RollingIndex:
+    def __init__(self, name: str, size: int):
+        self.name = name
+        self.size = size
+        self._tot = 2 * size
+        self._items: List[Any] = []
+        self._last_index = -1
+
+    def get_last_window(self) -> tuple[list[Any], int]:
+        return self._items, self._last_index
+
+    def get(self, skip_index: int) -> list[Any]:
+        """Return items with index > skip_index (reference: rolling_index.go:33-55)."""
+        if skip_index > self._last_index:
+            return []
+        cached_start = self._last_index - len(self._items) + 1
+        if skip_index + 1 < cached_start:
+            raise StoreError(self.name, StoreErrorKind.TOO_LATE, str(skip_index))
+        start = skip_index + 1 - cached_start
+        return self._items[start:]
+
+    def get_item(self, index: int) -> Any:
+        n = len(self._items)
+        cached_start = self._last_index - n + 1
+        if index < cached_start:
+            raise StoreError(self.name, StoreErrorKind.TOO_LATE, str(index))
+        if index > self._last_index:
+            raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(index))
+        return self._items[index - cached_start]
+
+    def set(self, item: Any, index: int) -> None:
+        # Updating a stored item in place is allowed (reference: rolling_index.go:78-84).
+        if self._items and index <= self._last_index:
+            cached_start = self._last_index - len(self._items) + 1
+            if index < cached_start:
+                raise StoreError(self.name, StoreErrorKind.TOO_LATE, str(index))
+            self._items[index - cached_start] = item
+            return
+        if self._last_index >= 0 and index > self._last_index + 1:
+            raise StoreError(self.name, StoreErrorKind.SKIPPED_INDEX, str(index))
+        self._items.append(item)
+        self._last_index = index
+        if len(self._items) >= self._tot:
+            self._roll()
+
+    def _roll(self) -> None:
+        self._items = self._items[self.size :]

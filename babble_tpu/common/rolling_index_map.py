@@ -1,0 +1,55 @@
+"""RollingIndexMap — per-participant rolling indexes.
+
+Reference: src/common/rolling_index_map.go. Keys are uint32 participant IDs;
+each maps to an independent RollingIndex of the same size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind
+from babble_tpu.common.rolling_index import RollingIndex
+
+
+class RollingIndexMap:
+    def __init__(self, name: str, size: int, keys: list[int] | None = None):
+        self.name = name
+        self.size = size
+        self.keys: List[int] = []
+        self.mapping: Dict[int, RollingIndex] = {}
+        for k in keys or []:
+            self.add_key(k)
+
+    def add_key(self, key: int) -> None:
+        if key in self.mapping:
+            raise StoreError(self.name, StoreErrorKind.KEY_ALREADY_EXISTS, str(key))
+        self.keys.append(key)
+        self.mapping[key] = RollingIndex(f"{self.name}[{key}]", self.size)
+
+    def get(self, key: int, skip_index: int) -> list[Any]:
+        if key not in self.mapping:
+            raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(key))
+        return self.mapping[key].get(skip_index)
+
+    def get_item(self, key: int, index: int) -> Any:
+        if key not in self.mapping:
+            raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(key))
+        return self.mapping[key].get_item(index)
+
+    def get_last(self, key: int) -> Any:
+        if key not in self.mapping:
+            raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(key))
+        last, _ = self.mapping[key].get_last_window()
+        if not last:
+            raise StoreError(self.name, StoreErrorKind.EMPTY, str(key))
+        return last[-1]
+
+    def set(self, key: int, item: Any, index: int) -> None:
+        if key not in self.mapping:
+            self.add_key(key)
+        self.mapping[key].set(item, index)
+
+    def known(self) -> dict[int, int]:
+        """Map key → last known index (reference: rolling_index_map.go:85-97)."""
+        return {k: ri.get_last_window()[1] for k, ri in self.mapping.items()}

@@ -1,0 +1,14 @@
+"""Trilean — three-valued logic for undecided votes (reference: src/common/trilean.go)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Trilean(enum.IntEnum):
+    UNDEFINED = 0
+    TRUE = 1
+    FALSE = 2
+
+    def __str__(self) -> str:
+        return {0: "Undefined", 1: "True", 2: "False"}[int(self)]

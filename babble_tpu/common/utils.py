@@ -1,0 +1,15 @@
+"""Small shared helpers (reference: src/common/median.go, hex codecs)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def median_int(values: Sequence[int]) -> int:
+    """Median of integers; even-length lists take the lower-middle element,
+    matching the reference's sort-and-index-n/2 behavior on timestamp lists
+    (reference: src/common/median.go:8, used by hashgraph.go:1264-1273)."""
+    if not values:
+        raise ValueError("median of empty sequence")
+    s = sorted(values)
+    return s[len(s) // 2]

@@ -1,0 +1,48 @@
+"""Canonical, byte-stable serialization for consensus objects.
+
+The reference hashes canonical JSON of event/round/frame bodies (ugorji codec
+with Canonical=true, reference: roundInfo.go:127-149, event.go:57-64). We use
+our own deterministic convention — sorted keys, no whitespace, bytes as
+base64 — which is stable across nodes (what consensus actually requires), not
+wire-compatible with Go.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+
+def _normalize(obj: Any) -> Any:
+    if isinstance(obj, (bytes, bytearray)):
+        return base64.b64encode(bytes(obj)).decode("ascii")
+    if isinstance(obj, dict):
+        return {str(k): _normalize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_normalize(v) for v in obj]
+    if isinstance(obj, (str, int, bool)) or obj is None:
+        return obj
+    raise TypeError(f"non-canonical type {type(obj)!r} in consensus object")
+
+
+def canonical_dumps(obj: Any) -> bytes:
+    """Deterministic JSON bytes: sorted keys, compact separators, base64 bytes.
+
+    Floats are rejected (consensus must not contain floats — SURVEY.md §7
+    hard part 4)."""
+    return json.dumps(
+        _normalize(obj), sort_keys=True, separators=(",", ":"), allow_nan=False
+    ).encode("utf-8")
+
+
+def canonical_loads(data: bytes) -> Any:
+    return json.loads(data.decode("utf-8"))
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode("ascii")
+
+
+def unb64(s: str) -> bytes:
+    return base64.b64decode(s)

@@ -1,0 +1,18 @@
+"""Hashing (reference: src/crypto/hash.go)."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def simple_hash_from_two_hashes(left: bytes, right: bytes) -> bytes:
+    """SHA256(left || right) — used to chain-hash peer sets
+    (reference: crypto/hash.go:17, peers/peer_set.go:104-115)."""
+    h = hashlib.sha256()
+    h.update(left)
+    h.update(right)
+    return h.digest()

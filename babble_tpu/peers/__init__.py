@@ -1,0 +1,7 @@
+"""Peers / validator sets (reference: src/peers/)."""
+
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.peers.json_peer_set import JSONPeerSet
+
+__all__ = ["JSONPeerSet", "Peer", "PeerSet"]

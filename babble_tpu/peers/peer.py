@@ -1,0 +1,51 @@
+"""Peer — a validator identity (reference: src/peers/peer.go:13)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from babble_tpu.crypto.keys import PublicKey, public_key_id
+
+
+@dataclass
+class Peer:
+    net_addr: str
+    pub_key_hex: str
+    moniker: str = ""
+    _id: int = field(default=0, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        # Normalize the pubkey hex to the canonical uppercase 0X form the
+        # reference writes to peers.json (json_peer_set.go:62-77 cleansing).
+        t = self.pub_key_hex
+        if t[:2].upper() == "0X":
+            t = t[2:]
+        self.pub_key_hex = "0X" + t.upper()
+
+    @property
+    def id(self) -> int:
+        """32-bit FNV-1a of the pubkey bytes (reference: peer.go:26-33)."""
+        if self._id == 0:
+            self._id = public_key_id(self.pub_key_bytes())
+        return self._id
+
+    def pub_key_bytes(self) -> bytes:
+        return bytes.fromhex(self.pub_key_hex[2:])
+
+    def public_key(self) -> PublicKey:
+        return PublicKey.from_bytes(self.pub_key_bytes())
+
+    def to_dict(self) -> dict:
+        return {
+            "NetAddr": self.net_addr,
+            "PubKeyHex": self.pub_key_hex,
+            "Moniker": self.moniker,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Peer":
+        return Peer(
+            net_addr=d.get("NetAddr", ""),
+            pub_key_hex=d["PubKeyHex"],
+            moniker=d.get("Moniker", ""),
+        )

@@ -1,0 +1,91 @@
+"""PeerSet — an immutable validator set (reference: src/peers/peer_set.go).
+
+Semantics that consensus depends on (must match the reference exactly):
+
+- ``super_majority() = 2n/3 + 1`` (integer division, peer_set.go:157)
+- ``trust_count() = ceil(n/3)`` (peer_set.go:168)
+- ``hash()`` = iterated SimpleHashFromTwoHashes over the peers' pubkey bytes
+  in set order — order-sensitive (peer_set.go:104-115)
+- membership changes produce NEW PeerSets (with_new_peer / with_removed_peer,
+  peer_set.go:46-69); the engine records one PeerSet per round.
+
+Peers are kept sorted by pubkey hex, which fixes the iteration order used by
+the hash and by tensor layouts in the TPU kernels (peer index = position in
+this sorted order).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional
+
+from babble_tpu.crypto.hashing import simple_hash_from_two_hashes
+from babble_tpu.peers.peer import Peer
+
+
+class PeerSet:
+    def __init__(self, peers: Iterable[Peer]):
+        self.peers: List[Peer] = sorted(peers, key=lambda p: p.pub_key_hex)
+        self.by_pub_key: Dict[str, Peer] = {p.pub_key_hex: p for p in self.peers}
+        self.by_id: Dict[int, Peer] = {p.id: p for p in self.peers}
+        self._hash: Optional[bytes] = None
+
+    def __len__(self) -> int:
+        return len(self.peers)
+
+    def __contains__(self, pub_key_hex: str) -> bool:
+        return pub_key_hex in self.by_pub_key
+
+    def ids(self) -> List[int]:
+        return [p.id for p in self.peers]
+
+    def pub_keys(self) -> List[str]:
+        return [p.pub_key_hex for p in self.peers]
+
+    def peer_index(self, pub_key_hex: str) -> int:
+        """Dense index of a peer in sorted order — the tensor coordinate used
+        by the JAX kernels (lastAncestors[:, peer_index] etc.)."""
+        for i, p in enumerate(self.peers):
+            if p.pub_key_hex == pub_key_hex:
+                return i
+        raise KeyError(pub_key_hex)
+
+    def with_new_peer(self, peer: Peer) -> "PeerSet":
+        if peer.pub_key_hex in self.by_pub_key:
+            return PeerSet(list(self.peers))
+        return PeerSet(list(self.peers) + [peer])
+
+    def with_removed_peer(self, peer: Peer) -> "PeerSet":
+        return self.with_removed_pub_key(peer.pub_key_hex)
+
+    def with_removed_pub_key(self, pub_key_hex: str) -> "PeerSet":
+        return PeerSet([p for p in self.peers if p.pub_key_hex != pub_key_hex])
+
+    def super_majority(self) -> int:
+        """Strictly more than 2/3: 2n/3 + 1 (reference: peer_set.go:157)."""
+        return 2 * len(self.peers) // 3 + 1
+
+    def trust_count(self) -> int:
+        """At least 1/3: ceil(n/3) (reference: peer_set.go:168)."""
+        return int(math.ceil(len(self.peers) / 3))
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            h = b""
+            for p in self.peers:
+                h = simple_hash_from_two_hashes(h, p.pub_key_bytes())
+            self._hash = h
+        return self._hash
+
+    def to_peer_slice(self) -> List[dict]:
+        return [p.to_dict() for p in self.peers]
+
+    @staticmethod
+    def from_peer_slice(items: List[dict]) -> "PeerSet":
+        return PeerSet([Peer.from_dict(d) for d in items])
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, PeerSet) and self.pub_keys() == other.pub_keys()
+
+    def __repr__(self) -> str:
+        return f"PeerSet({[p.moniker or p.pub_key_hex[:10] for p in self.peers]})"

@@ -1,0 +1,7 @@
+"""Version info (reference: src/version/version.go)."""
+
+MAJOR = 0
+MINOR = 1
+PATCH = 0
+
+__version__ = f"{MAJOR}.{MINOR}.{PATCH}"

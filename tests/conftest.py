@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding
+(pjit/shard_map over a Mesh) is exercised without TPU hardware. Must run
+before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def keys3():
+    """Three deterministic private keys for small fixtures."""
+    from babble_tpu.crypto.keys import PrivateKey
+
+    return [PrivateKey(d) for d in (0xA11CE, 0xB0B, 0xCA401)]

@@ -1,0 +1,121 @@
+"""Tests for babble_tpu.common (reference test model: src/common/*_test.go)."""
+
+import pytest
+
+from babble_tpu.common import (
+    LRU,
+    RollingIndex,
+    RollingIndexMap,
+    StoreError,
+    StoreErrorKind,
+    Trilean,
+    is_store_err,
+    median_int,
+)
+
+
+class TestLRU:
+    def test_add_get(self):
+        lru = LRU(2)
+        lru.add("a", 1)
+        lru.add("b", 2)
+        assert lru.get("a") == (1, True)
+        assert lru.get("c") == (None, False)
+
+    def test_eviction_order(self):
+        evicted = []
+        lru = LRU(2, evict_callback=lambda k, v: evicted.append(k))
+        lru.add("a", 1)
+        lru.add("b", 2)
+        lru.get("a")  # refresh a; b is now LRU
+        lru.add("c", 3)
+        assert evicted == ["b"]
+        assert "a" in lru and "c" in lru
+
+    def test_update_no_evict(self):
+        lru = LRU(2)
+        lru.add("a", 1)
+        lru.add("a", 9)
+        assert len(lru) == 1
+        assert lru.get("a") == (9, True)
+
+
+class TestRollingIndex:
+    def test_sequential_set_get(self):
+        ri = RollingIndex("t", 10)
+        for i in range(5):
+            ri.set(f"item{i}", i)
+        assert ri.get(-1) == [f"item{i}" for i in range(5)]
+        assert ri.get(2) == ["item3", "item4"]
+        assert ri.get_item(3) == "item3"
+
+    def test_skipped_index(self):
+        ri = RollingIndex("t", 10)
+        ri.set("a", 0)
+        with pytest.raises(StoreError) as ei:
+            ri.set("c", 2)
+        assert is_store_err(ei.value, StoreErrorKind.SKIPPED_INDEX)
+
+    def test_roll_evicts_oldest_half(self):
+        ri = RollingIndex("t", 5)  # rolls at 10 items, keeps last 5
+        for i in range(10):
+            ri.set(i, i)
+        with pytest.raises(StoreError) as ei:
+            ri.get_item(2)
+        assert is_store_err(ei.value, StoreErrorKind.TOO_LATE)
+        assert ri.get_item(9) == 9
+        with pytest.raises(StoreError) as ei:
+            ri.get_item(42)
+        assert is_store_err(ei.value, StoreErrorKind.KEY_NOT_FOUND)
+
+    def test_get_too_late(self):
+        ri = RollingIndex("t", 5)
+        for i in range(10):
+            ri.set(i, i)
+        with pytest.raises(StoreError) as ei:
+            ri.get(1)
+        assert is_store_err(ei.value, StoreErrorKind.TOO_LATE)
+
+    def test_in_place_update(self):
+        ri = RollingIndex("t", 5)
+        ri.set("a", 0)
+        ri.set("A", 0)
+        assert ri.get_item(0) == "A"
+        assert ri.get_last_window()[1] == 0
+
+
+class TestRollingIndexMap:
+    def test_basic(self):
+        rim = RollingIndexMap("t", 10, [1, 2])
+        rim.set(1, "x", 0)
+        rim.set(2, "y", 0)
+        rim.set(2, "z", 1)
+        assert rim.get_last(1) == "x"
+        assert rim.get_last(2) == "z"
+        assert rim.known() == {1: 0, 2: 1}
+
+    def test_unknown_key(self):
+        rim = RollingIndexMap("t", 10, [1])
+        with pytest.raises(StoreError) as ei:
+            rim.get(9, -1)
+        assert is_store_err(ei.value, StoreErrorKind.KEY_NOT_FOUND)
+
+    def test_duplicate_key(self):
+        rim = RollingIndexMap("t", 10, [1])
+        with pytest.raises(StoreError) as ei:
+            rim.add_key(1)
+        assert is_store_err(ei.value, StoreErrorKind.KEY_ALREADY_EXISTS)
+
+
+def test_trilean():
+    assert str(Trilean.UNDEFINED) == "Undefined"
+    assert str(Trilean.TRUE) == "True"
+    assert str(Trilean.FALSE) == "False"
+
+
+def test_median():
+    assert median_int([3, 1, 2]) == 2
+    assert median_int([4, 1, 3, 2]) == 3  # lower-middle at even length: index n//2
+    assert median_int([7]) == 7
+    with pytest.raises(ValueError):
+        median_int([])

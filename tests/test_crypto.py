@@ -1,0 +1,106 @@
+"""Tests for babble_tpu.crypto (reference test model: src/crypto/keys/*_test.go)."""
+
+import pytest
+
+from babble_tpu.crypto import (
+    PrivateKey,
+    PublicKey,
+    SimpleKeyfile,
+    decode_signature,
+    encode_signature,
+    generate_key,
+    public_key_id,
+    sha256,
+    simple_hash_from_two_hashes,
+)
+from babble_tpu.crypto import secp256k1 as curve
+from babble_tpu.crypto.canonical import canonical_dumps
+
+
+def test_sha256_vectors():
+    assert (
+        sha256(b"").hex()
+        == "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+    )
+    assert (
+        sha256(b"abc").hex()
+        == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+
+
+def test_simple_hash_from_two_hashes():
+    assert simple_hash_from_two_hashes(b"a", b"b") == sha256(b"ab")
+
+
+def test_curve_basics():
+    assert curve.is_on_curve(curve.G)
+    two_g = curve.point_add(curve.G, curve.G)
+    assert curve.is_on_curve(two_g)
+    assert curve.point_mul(2, curve.G) == two_g
+    # n*G = infinity
+    assert curve.point_mul(curve.N, curve.G) is None
+
+
+def test_sign_verify_roundtrip():
+    key = PrivateKey(12345678901234567890)
+    pub = key.public_key
+    h = sha256(b"hello world")
+    sig = key.sign(h)
+    assert pub.verify(h, sig)
+    assert not pub.verify(sha256(b"other"), sig)
+    # tampered signature
+    r, s = decode_signature(sig)
+    assert not pub.verify(h, encode_signature(r, s + 1))
+
+
+def test_rfc6979_determinism():
+    key = PrivateKey(0xDEADBEEF)
+    h = sha256(b"msg")
+    assert key.sign_rs(h) == key.sign_rs(h)
+
+
+def test_pure_python_vs_openssl_cross():
+    """Pure-Python verify accepts OpenSSL-format sigs and vice versa."""
+    key = PrivateKey(0xC0FFEE)
+    h = sha256(b"cross-check")
+    r, s = key.sign_rs(h)
+    assert curve.verify((key.public_key.x, key.public_key.y), h, r, s)
+    assert key.public_key.verify_rs(h, r, s)
+
+
+def test_signature_string_format():
+    """Base-36 encoding matches Go big.Int.Text(36) conventions."""
+    assert encode_signature(35, 36) == "z|10"
+    assert decode_signature("z|10") == (35, 36)
+    assert decode_signature("Z|10") == (35, 36)  # case-insensitive decode
+    with pytest.raises(ValueError):
+        decode_signature("nopipe")
+
+
+def test_pubkey_marshal_roundtrip():
+    key = generate_key()
+    pub = key.public_key
+    assert PublicKey.from_bytes(pub.bytes()) == pub
+    assert PublicKey.from_hex(pub.hex()) == pub
+    assert pub.hex().startswith("0X")
+
+
+def test_fnv_id():
+    # FNV-1a 32-bit known vectors
+    assert public_key_id(b"") == 0x811C9DC5
+    assert public_key_id(b"a") == 0xE40C292C
+
+
+def test_keyfile_roundtrip(tmp_path):
+    kf = SimpleKeyfile(str(tmp_path / "priv_key"))
+    key = generate_key()
+    kf.write_key(key)
+    assert kf.read_key() == key
+
+
+def test_canonical_dumps_stability():
+    a = canonical_dumps({"b": 1, "a": [b"\x00\x01", "x"], "c": None})
+    b = canonical_dumps({"c": None, "a": [b"\x00\x01", "x"], "b": 1})
+    assert a == b
+    with pytest.raises(TypeError):
+        canonical_dumps({"f": 1.5})

@@ -1,0 +1,66 @@
+"""Tests for babble_tpu.peers (reference test model: src/peers/*_test.go)."""
+
+from babble_tpu.crypto import generate_key
+from babble_tpu.peers import JSONPeerSet, Peer, PeerSet
+
+
+def make_peers(n):
+    out = []
+    for i in range(n):
+        k = generate_key()
+        out.append(Peer(net_addr=f"127.0.0.1:{9000+i}", pub_key_hex=k.public_key.hex(), moniker=f"n{i}"))
+    return out
+
+
+def test_thresholds():
+    # n: (super_majority, trust_count) — 2n/3+1 and ceil(n/3)
+    expect = {1: (1, 1), 2: (2, 1), 3: (3, 1), 4: (3, 2), 5: (4, 2), 6: (5, 2), 7: (5, 3)}
+    for n, (sm, tc) in expect.items():
+        ps = PeerSet(make_peers(n))
+        assert ps.super_majority() == sm, n
+        assert ps.trust_count() == tc, n
+
+
+def test_sorted_and_hash_order_sensitive():
+    peers = make_peers(4)
+    ps1 = PeerSet(peers)
+    ps2 = PeerSet(list(reversed(peers)))
+    assert ps1.pub_keys() == ps2.pub_keys()  # sorted internally
+    assert ps1.hash() == ps2.hash()
+    smaller = ps1.with_removed_peer(peers[0])
+    assert smaller.hash() != ps1.hash()
+
+
+def test_membership_ops():
+    peers = make_peers(3)
+    ps = PeerSet(peers[:2])
+    grown = ps.with_new_peer(peers[2])
+    assert len(grown) == 3 and len(ps) == 2  # immutability
+    again = grown.with_new_peer(peers[2])
+    assert len(again) == 3  # idempotent add
+    shrunk = grown.with_removed_peer(peers[1])
+    assert len(shrunk) == 2
+    assert peers[1].pub_key_hex not in shrunk
+
+
+def test_peer_index_matches_sorted_order():
+    ps = PeerSet(make_peers(5))
+    for i, p in enumerate(ps.peers):
+        assert ps.peer_index(p.pub_key_hex) == i
+
+
+def test_json_roundtrip(tmp_path):
+    ps = PeerSet(make_peers(3))
+    jps = JSONPeerSet(str(tmp_path))
+    jps.write(ps)
+    loaded = JSONPeerSet(str(tmp_path)).peer_set()
+    assert loaded == ps
+    assert [p.moniker for p in loaded.peers] == [p.moniker for p in ps.peers]
+
+
+def test_pubkey_cleansing():
+    k = generate_key()
+    lower = "0x" + k.public_key.bytes().hex()
+    p = Peer(net_addr="", pub_key_hex=lower)
+    assert p.pub_key_hex == k.public_key.hex()
+    assert p.id == k.public_key.id()
