@@ -1,9 +1,10 @@
 """RollingIndex — bounded FIFO with strict sequential indexes.
 
 Reference: src/common/rolling_index.go:8-110. Items are appended at
-consecutive integer indexes; when the buffer exceeds 2*size it evicts the
-oldest half. Reads below the retained window raise TOO_LATE; reads beyond
-the head raise KEY_NOT_FOUND; non-sequential appends raise SKIPPED_INDEX.
+consecutive integer indexes; when the buffer holds `size` items, the next
+append first evicts the oldest half (keeping items[size//2:]). Reads below
+the retained window raise TOO_LATE; reads beyond the head raise
+KEY_NOT_FOUND; non-sequential appends raise SKIPPED_INDEX.
 """
 
 from __future__ import annotations
@@ -17,7 +18,6 @@ class RollingIndex:
     def __init__(self, name: str, size: int):
         self.name = name
         self.size = size
-        self._tot = 2 * size
         self._items: List[Any] = []
         self._last_index = -1
 
@@ -53,10 +53,11 @@ class RollingIndex:
             return
         if self._last_index >= 0 and index > self._last_index + 1:
             raise StoreError(self.name, StoreErrorKind.SKIPPED_INDEX, str(index))
+        if len(self._items) >= self.size:
+            self._roll()
         self._items.append(item)
         self._last_index = index
-        if len(self._items) >= self._tot:
-            self._roll()
 
     def _roll(self) -> None:
-        self._items = self._items[self.size :]
+        # Evict the earlier half, keeping items[size//2:] (rolling_index.go:105-109).
+        self._items = self._items[self.size // 2 :]

@@ -6,10 +6,18 @@ from typing import Sequence
 
 
 def median_int(values: Sequence[int]) -> int:
-    """Median of integers; even-length lists take the lower-middle element,
-    matching the reference's sort-and-index-n/2 behavior on timestamp lists
-    (reference: src/common/median.go:8, used by hashgraph.go:1264-1273)."""
-    if not values:
-        raise ValueError("median of empty sequence")
+    """Median of integers, matching the reference exactly: empty input yields
+    0; even-length lists average the two middle values with truncating
+    (toward-zero) integer division, as Go's int64 division does; odd-length
+    lists take the middle element (reference: src/common/median.go:8-29,
+    used for BFT frame timestamps at hashgraph.go:1264-1273)."""
     s = sorted(values)
-    return s[len(s) // 2]
+    n = len(s)
+    if n == 0:
+        return 0
+    if n % 2 == 0:
+        mid = n // 2 - 1
+        total = s[mid] + s[mid + 1]
+        # Go integer division truncates toward zero; Python's // floors.
+        return total // 2 if total >= 0 else -((-total) // 2)
+    return s[n // 2]

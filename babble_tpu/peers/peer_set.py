@@ -3,7 +3,7 @@
 Semantics that consensus depends on (must match the reference exactly):
 
 - ``super_majority() = 2n/3 + 1`` (integer division, peer_set.go:157)
-- ``trust_count() = ceil(n/3)`` (peer_set.go:168)
+- ``trust_count()`` = 0 for n<=1, else ceil(n/3) (peer_set.go:165-177)
 - ``hash()`` = iterated SimpleHashFromTwoHashes over the peers' pubkey bytes
   in set order — order-sensitive (peer_set.go:104-115)
 - membership changes produce NEW PeerSets (with_new_peer / with_removed_peer,
@@ -66,7 +66,10 @@ class PeerSet:
         return 2 * len(self.peers) // 3 + 1
 
     def trust_count(self) -> int:
-        """At least 1/3: ceil(n/3) (reference: peer_set.go:168)."""
+        """Minimum signature count representing finality: 0 for sets of one
+        or fewer peers, ceil(n/3) otherwise (reference: peer_set.go:165-177)."""
+        if len(self.peers) <= 1:
+            return 0
         return int(math.ceil(len(self.peers) / 3))
 
     def hash(self) -> bytes:

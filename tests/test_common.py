@@ -57,9 +57,12 @@ class TestRollingIndex:
         assert is_store_err(ei.value, StoreErrorKind.SKIPPED_INDEX)
 
     def test_roll_evicts_oldest_half(self):
-        ri = RollingIndex("t", 5)  # rolls at 10 items, keeps last 5
+        # Capacity 5: the 6th append first evicts items[:size//2], so after
+        # ten inserts the retained window is [6..9] (rolling_index.go:72-109).
+        ri = RollingIndex("t", 5)
         for i in range(10):
             ri.set(i, i)
+        assert ri.get_last_window() == ([6, 7, 8, 9], 9)
         with pytest.raises(StoreError) as ei:
             ri.get_item(2)
         assert is_store_err(ei.value, StoreErrorKind.TOO_LATE)
@@ -115,7 +118,9 @@ def test_trilean():
 
 def test_median():
     assert median_int([3, 1, 2]) == 2
-    assert median_int([4, 1, 3, 2]) == 3  # lower-middle at even length: index n//2
+    # Even length averages the two middle values (median.go:20-24): (2+3)/2 = 2.
+    assert median_int([4, 1, 3, 2]) == 2
     assert median_int([7]) == 7
-    with pytest.raises(ValueError):
-        median_int([])
+    assert median_int([]) == 0  # reference returns 0 for empty input
+    # Go's int64 division truncates toward zero: (-3 + -4)/2 = -3, not -4.
+    assert median_int([-1, -3, -4, -6]) == -3

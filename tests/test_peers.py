@@ -13,8 +13,9 @@ def make_peers(n):
 
 
 def test_thresholds():
-    # n: (super_majority, trust_count) — 2n/3+1 and ceil(n/3)
-    expect = {1: (1, 1), 2: (2, 1), 3: (3, 1), 4: (3, 2), 5: (4, 2), 6: (5, 2), 7: (5, 3)}
+    # n: (super_majority, trust_count) — 2n/3+1, and ceil(n/3) but 0 when n<=1
+    # (peer_set.go:157, 165-177: single-peer sets have no trust threshold).
+    expect = {1: (1, 0), 2: (2, 1), 3: (3, 1), 4: (3, 2), 5: (4, 2), 6: (5, 2), 7: (5, 3)}
     for n, (sm, tc) in expect.items():
         ps = PeerSet(make_peers(n))
         assert ps.super_majority() == sm, n
