@@ -1,0 +1,223 @@
+"""Block — a section of the hashgraph that reached consensus
+(reference: src/hashgraph/block.go:16-357)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.crypto.hashing import sha256
+from babble_tpu.crypto.keys import PrivateKey, PublicKey
+from babble_tpu.hashgraph.event import BlockSignature, decode_hash, encode_hash
+from babble_tpu.hashgraph.internal_transaction import (
+    InternalTransaction,
+    InternalTransactionReceipt,
+)
+from babble_tpu.peers.peer_set import PeerSet
+
+
+@dataclass
+class BlockBody:
+    """reference: block.go:16-26."""
+
+    index: int = -1
+    round_received: int = -1
+    timestamp: int = 0
+    state_hash: bytes = b""
+    frame_hash: bytes = b""
+    peers_hash: bytes = b""
+    transactions: List[bytes] = field(default_factory=list)
+    internal_transactions: List[InternalTransaction] = field(default_factory=list)
+    internal_transaction_receipts: List[InternalTransactionReceipt] = field(
+        default_factory=list
+    )
+
+    def to_dict(self) -> dict:
+        return {
+            "Index": self.index,
+            "RoundReceived": self.round_received,
+            "Timestamp": self.timestamp,
+            "StateHash": self.state_hash,
+            "FrameHash": self.frame_hash,
+            "PeersHash": self.peers_hash,
+            "Transactions": list(self.transactions),
+            "InternalTransactions": [
+                t.to_dict() for t in self.internal_transactions
+            ],
+            "InternalTransactionReceipts": [
+                r.to_dict() for r in self.internal_transaction_receipts
+            ],
+        }
+
+    def hash(self) -> bytes:
+        """SHA256 of the canonical encoding — what validators sign
+        (reference: block.go:49-55)."""
+        return sha256(canonical_dumps(self.to_dict()))
+
+    @staticmethod
+    def from_dict(d: dict) -> "BlockBody":
+        from babble_tpu.crypto.canonical import unb64
+
+        def as_bytes(v):
+            return unb64(v) if isinstance(v, str) else bytes(v)
+
+        return BlockBody(
+            index=d["Index"],
+            round_received=d["RoundReceived"],
+            timestamp=d["Timestamp"],
+            state_hash=as_bytes(d.get("StateHash", b"")),
+            frame_hash=as_bytes(d.get("FrameHash", b"")),
+            peers_hash=as_bytes(d.get("PeersHash", b"")),
+            transactions=[as_bytes(t) for t in d.get("Transactions") or []],
+            internal_transactions=[
+                InternalTransaction.from_dict(t)
+                for t in d.get("InternalTransactions") or []
+            ],
+            internal_transaction_receipts=[
+                InternalTransactionReceipt.from_dict(r)
+                for r in d.get("InternalTransactionReceipts") or []
+            ],
+        )
+
+
+class Block:
+    """BlockBody + accumulated validator signatures
+    (reference: block.go:125-192)."""
+
+    def __init__(self, body: BlockBody, peer_set: Optional[PeerSet] = None):
+        self.body = body
+        self.signatures: Dict[str, str] = {}  # validator hex => signature
+        self.peer_set = peer_set
+        self._hash: bytes = b""
+        self._hex: str = ""
+
+    @staticmethod
+    def new(
+        block_index: int,
+        round_received: int,
+        frame_hash: bytes,
+        peer_set: PeerSet,
+        txs: List[bytes],
+        itxs: List[InternalTransaction],
+        timestamp: int,
+    ) -> "Block":
+        """reference: block.go:161-192."""
+        body = BlockBody(
+            index=block_index,
+            round_received=round_received,
+            timestamp=timestamp,
+            state_hash=b"",
+            frame_hash=frame_hash,
+            peers_hash=peer_set.hash(),
+            transactions=list(txs),
+            internal_transactions=list(itxs),
+        )
+        return Block(body, peer_set=peer_set)
+
+    @staticmethod
+    def from_frame(block_index: int, frame) -> "Block":
+        """Assemble a block from a frame's events, concatenating their
+        payloads in consensus order (reference: block.go:135-158)."""
+        txs: List[bytes] = []
+        itxs: List[InternalTransaction] = []
+        for fe in frame.events:
+            txs.extend(fe.core.transactions())
+            itxs.extend(fe.core.internal_transactions())
+        return Block.new(
+            block_index,
+            frame.round,
+            frame.hash(),
+            frame.peers,
+            txs,
+            itxs,
+            frame.timestamp,
+        )
+
+    # -- accessors ---------------------------------------------------------
+
+    def index(self) -> int:
+        return self.body.index
+
+    def round_received(self) -> int:
+        return self.body.round_received
+
+    def timestamp(self) -> int:
+        return self.body.timestamp
+
+    def transactions(self) -> List[bytes]:
+        return self.body.transactions
+
+    def internal_transactions(self) -> List[InternalTransaction]:
+        return self.body.internal_transactions
+
+    def internal_transaction_receipts(self) -> List[InternalTransactionReceipt]:
+        return self.body.internal_transaction_receipts
+
+    def state_hash(self) -> bytes:
+        return self.body.state_hash
+
+    def frame_hash(self) -> bytes:
+        return self.body.frame_hash
+
+    def peers_hash(self) -> bytes:
+        return self.body.peers_hash
+
+    def get_signatures(self) -> List[BlockSignature]:
+        """reference: block.go:241-254."""
+        return [
+            BlockSignature(
+                validator=decode_hash(v), index=self.index(), signature=sig
+            )
+            for v, sig in self.signatures.items()
+        ]
+
+    # -- hashing / signing -------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"Body": self.body.to_dict(), "Signatures": dict(self.signatures)}
+
+    def hash(self) -> bytes:
+        """Hash of the whole block incl. signatures (reference: block.go:296-306)."""
+        if not self._hash:
+            self._hash = sha256(canonical_dumps(self.to_dict()))
+        return self._hash
+
+    def hex(self) -> str:
+        if not self._hex:
+            self._hex = encode_hash(self.hash())
+        return self._hex
+
+    def sign(self, key: PrivateKey) -> BlockSignature:
+        """Sign the body hash; returns a BlockSignature, does NOT append it
+        (reference: block.go:318-334)."""
+        return BlockSignature(
+            validator=key.public_key.bytes(),
+            index=self.index(),
+            signature=key.sign(self.body.hash()),
+        )
+
+    def set_signature(self, bs: BlockSignature) -> None:
+        self.signatures[bs.validator_hex()] = bs.signature
+        self._hash = b""
+        self._hex = ""
+
+    def verify_signature(self, bs: BlockSignature) -> bool:
+        """reference: block.go:343-357."""
+        try:
+            pub = PublicKey.from_bytes(bs.validator)
+        except Exception:
+            return False
+        return pub.verify(self.body.hash(), bs.signature)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Block":
+        b = Block(BlockBody.from_dict(d["Body"]))
+        b.signatures = dict(d.get("Signatures") or {})
+        return b
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"Block(index={self.index()}, rr={self.round_received()}, "
+            f"txs={len(self.transactions())}, sigs={len(self.signatures)})"
+        )

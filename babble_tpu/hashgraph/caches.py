@@ -1,0 +1,167 @@
+"""Consensus-layer caches (reference: src/hashgraph/caches.go:30-345)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind
+from babble_tpu.common.rolling_index_map import RollingIndexMap
+from babble_tpu.hashgraph.event import BlockSignature
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+
+INT32_MAX = 2**31 - 1
+
+
+class ParticipantEventsCache:
+    """Per-peer rolling index of event hashes (reference: caches.go:32-123)."""
+
+    def __init__(self, size: int):
+        self.participants = PeerSet([])
+        self.rim = RollingIndexMap("ParticipantEvents", size)
+
+    def add_peer(self, peer: Peer) -> None:
+        self.participants = self.participants.with_new_peer(peer)
+        self.rim.add_key(peer.id)
+
+    def _participant_id(self, participant: str) -> int:
+        """Participant keys are case-insensitive pubkey hex
+        (reference: caches.go:54-62)."""
+        p = self.participants.by_pub_key.get(participant.upper())
+        if p is None:
+            raise StoreError(
+                "ParticipantEvents",
+                StoreErrorKind.UNKNOWN_PARTICIPANT,
+                participant.upper(),
+            )
+        return p.id
+
+    def get(self, participant: str, skip_index: int) -> List[str]:
+        return list(self.rim.get(self._participant_id(participant), skip_index))
+
+    def get_item(self, participant: str, index: int) -> str:
+        return self.rim.get_item(self._participant_id(participant), index)
+
+    def get_last(self, participant: str) -> str:
+        return self.rim.get_last(self._participant_id(participant))
+
+    def set(self, participant: str, hash_: str, index: int) -> None:
+        self.rim.set(self._participant_id(participant), hash_, index)
+
+    def known(self) -> Dict[int, int]:
+        """participant id => last known index."""
+        return self.rim.known()
+
+
+class PeerSetCache:
+    """Round-interval lookup of peer-sets + the repertoire of all peers ever
+    seen (reference: caches.go:126-222)."""
+
+    def __init__(self) -> None:
+        self.rounds: List[int] = []
+        self.peer_sets: Dict[int, PeerSet] = {}
+        self.repertoire_by_pub_key: Dict[str, Peer] = {}
+        self.repertoire_by_id: Dict[int, Peer] = {}
+        self.first_rounds: Dict[int, int] = {}
+
+    def set(self, round: int, peer_set: PeerSet) -> None:
+        if round in self.peer_sets:
+            raise StoreError(
+                "PeerSetCache", StoreErrorKind.KEY_ALREADY_EXISTS, str(round)
+            )
+        self.peer_sets[round] = peer_set
+        self.rounds.append(round)
+        self.rounds.sort()
+        for p in peer_set.peers:
+            self.repertoire_by_pub_key[p.pub_key_hex] = p
+            self.repertoire_by_id[p.id] = p
+            fr = self.first_rounds.get(p.id)
+            if fr is None or fr > round:
+                self.first_rounds[p.id] = round
+
+    def get(self, round: int) -> PeerSet:
+        """The peer-set effective at `round`: the entry at the largest
+        recorded round <= `round` (reference: caches.go:169-193)."""
+        ps = self.peer_sets.get(round)
+        if ps is not None:
+            return ps
+        if not self.rounds:
+            raise StoreError("PeerSetCache", StoreErrorKind.KEY_NOT_FOUND, str(round))
+        if round < self.rounds[0]:
+            return self.peer_sets[self.rounds[0]]
+        for i in range(len(self.rounds) - 1):
+            if self.rounds[i] <= round < self.rounds[i + 1]:
+                return self.peer_sets[self.rounds[i]]
+        return self.peer_sets[self.rounds[-1]]
+
+    def get_all(self) -> Dict[int, List[Peer]]:
+        return {r: self.peer_sets[r].peers for r in self.rounds}
+
+    def first_round(self, id_: int) -> tuple[int, bool]:
+        fr = self.first_rounds.get(id_)
+        if fr is not None:
+            return fr, True
+        return INT32_MAX, False
+
+
+class PendingRound:
+    """A round making its way through consensus (reference: caches.go:225-228)."""
+
+    __slots__ = ("index", "decided")
+
+    def __init__(self, index: int, decided: bool = False):
+        self.index = index
+        self.decided = decided
+
+
+class PendingRoundsCache:
+    """Ordered queue of undecided rounds (reference: caches.go:244-297)."""
+
+    def __init__(self) -> None:
+        self.items: Dict[int, PendingRound] = {}
+        self.sorted_items: List[PendingRound] = []
+
+    def queued(self, round: int) -> bool:
+        return round in self.items
+
+    def set(self, pending_round: PendingRound) -> None:
+        self.items[pending_round.index] = pending_round
+        self.sorted_items.append(pending_round)
+        self.sorted_items.sort(key=lambda pr: pr.index)
+
+    def get_ordered_pending_rounds(self) -> List[PendingRound]:
+        return self.sorted_items
+
+    def update(self, decided_rounds: List[int]) -> None:
+        for drn in decided_rounds:
+            pr = self.items.get(drn)
+            if pr is not None:
+                pr.decided = True
+
+    def clean(self, processed_rounds: List[int]) -> None:
+        for pr in processed_rounds:
+            self.items.pop(pr, None)
+        self.sorted_items = sorted(self.items.values(), key=lambda p: p.index)
+
+
+class SigPool:
+    """Pool of block signatures awaiting processing (reference: caches.go:300-345)."""
+
+    def __init__(self) -> None:
+        self.items: Dict[str, BlockSignature] = {}
+
+    def add(self, bs: BlockSignature) -> None:
+        self.items[bs.key()] = bs
+
+    def remove(self, key: str) -> None:
+        self.items.pop(key, None)
+
+    def remove_slice(self, sigs: List[BlockSignature]) -> None:
+        for s in sigs:
+            self.items.pop(s.key(), None)
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def slice(self) -> List[BlockSignature]:
+        return list(self.items.values())

@@ -1,0 +1,17 @@
+"""Engine errors (reference: src/hashgraph/errors.go:1-32)."""
+
+from __future__ import annotations
+
+
+class SelfParentError(Exception):
+    """Raised when an event's self-parent is not the creator's last known
+    event. ``normal=True`` marks the benign concurrent-duplicate-insert race
+    that must be tolerated, not reported (reference: errors.go:3-32)."""
+
+    def __init__(self, msg: str, normal: bool):
+        super().__init__(msg)
+        self.normal = normal
+
+
+def is_normal_self_parent_error(err: object) -> bool:
+    return isinstance(err, SelfParentError) and err.normal

@@ -1,0 +1,928 @@
+"""Hashgraph — the consensus engine.
+
+This is the CPU-reference oracle for the TPU kernels (SURVEY.md §7 step 3):
+an exact re-implementation of the reference pipeline semantics —
+``insert_event → divide_rounds → decide_fame → decide_round_received →
+process_decided_rounds`` — against which ``babble_tpu.ops.dag`` is
+differential-tested on the golden DAGs.
+
+Reference mapping (file:line into /root/reference/src/hashgraph/hashgraph.go):
+- predicates ancestor/selfAncestor/see/stronglySee: 96-206
+- round / witness / lamportTimestamp: 208-327, 343-387
+- coordinates maintenance: 445-519
+- insert path with fork checks: 672-750; trusted frame-event insert: 754-802
+- DivideRounds: 807-872; DecideFame incl. coin rounds: 875-998
+- DecideRoundReceived: 1002-1095; ProcessDecidedRounds/GetFrame: 1100-1289
+- sig pool / anchor block: 1295-1408; Reset/Bootstrap: 1431-1536
+- wire conversion: 1538-1595; CheckBlock: 1599-1630
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind, is_store_err
+from babble_tpu.common.lru import LRU
+from babble_tpu.common.utils import median_int
+from babble_tpu.hashgraph.block import Block
+from babble_tpu.hashgraph.caches import PendingRound, PendingRoundsCache, SigPool
+from babble_tpu.hashgraph.errors import (
+    SelfParentError,
+    is_normal_self_parent_error,
+)
+from babble_tpu.hashgraph.event import (
+    Event,
+    EventBody,
+    EventCoordinates,
+    FrameEvent,
+    WireEvent,
+    decode_hash,
+    sort_frame_events,
+)
+from babble_tpu.hashgraph.frame import Frame, Root
+from babble_tpu.hashgraph.round_info import RoundInfo
+from babble_tpu.hashgraph.store import Store
+from babble_tpu.peers.peer_set import PeerSet
+
+logger = logging.getLogger("babble_tpu.hashgraph")
+
+# How many FrameEvents are included in a Root. Must be identical across
+# peers or they produce different Frames/Blocks (reference: hashgraph.go:15-22).
+ROOT_DEPTH = 10
+
+# Frequency of coin rounds in the fame decision (reference: hashgraph.go:24-25).
+COIN_ROUND_FREQ = 4
+
+# InternalCommitCallback: commits a block; the node's core layer processes
+# the commit response (reference: hashgraph.go:1677-1688).
+CommitCallback = Callable[[Block], None]
+
+
+def dummy_commit_callback(block: Block) -> None:
+    """reference: hashgraph.go:1687-1689."""
+
+
+def middle_bit(ehex: str) -> bool:
+    """Pseudo-random bit for coin rounds: the middle byte of the event hash,
+    False iff zero (reference: hashgraph.go:1666-1675)."""
+    hash_ = decode_hash(ehex)
+    if len(hash_) > 0 and hash_[len(hash_) // 2] == 0:
+        return False
+    return True
+
+
+class Hashgraph:
+    """DAG of events + methods extracting a total consensus order of
+    transactions onto a blockchain (reference: hashgraph.go:30-80)."""
+
+    def __init__(
+        self,
+        store: Store,
+        commit_callback: CommitCallback = dummy_commit_callback,
+    ):
+        self.store = store
+        # FIFO of events whose consensus order is not yet determined.
+        self.undetermined_events: List[str] = []
+        self.pending_rounds = PendingRoundsCache()
+        self.pending_signatures = SigPool()
+        self.last_consensus_round: Optional[int] = None
+        self.first_consensus_round: Optional[int] = None
+        self.anchor_block: Optional[int] = None
+        self.round_lower_bound: Optional[int] = None  # fast-sync boundary
+        self.last_committed_round_events = 0
+        self.consensus_transactions = 0
+        self.pending_loaded_events = 0
+        self.commit_callback = commit_callback
+        self.topological_index = 0
+
+        cs = store.cache_size()
+        self._ancestor_cache = LRU(cs)
+        self._self_ancestor_cache = LRU(cs)
+        self._strongly_see_cache = LRU(cs)
+        self._round_cache = LRU(cs)
+        self._timestamp_cache = LRU(cs)
+        self._witness_cache = LRU(cs)
+
+    def init(self, peer_set: PeerSet) -> None:
+        """Set the genesis peer-set at round 0 (reference: hashgraph.go:84-89)."""
+        self.store.set_peer_set(0, peer_set)
+
+    # =========================================================================
+    # DAG predicates
+    # =========================================================================
+
+    def ancestor(self, x: str, y: str) -> bool:
+        """True if y is an ancestor of x — O(1) via lastAncestors
+        (reference: hashgraph.go:96-128)."""
+        k = (x, y)
+        v, ok = self._ancestor_cache.get(k)
+        if ok:
+            return v
+        a = self._ancestor(x, y)
+        self._ancestor_cache.add(k, a)
+        return a
+
+    def _ancestor(self, x: str, y: str) -> bool:
+        if x == y:
+            return True
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        entry = ex.last_ancestors.get(ey.creator())
+        return entry is not None and entry.index >= ey.index()
+
+    def self_ancestor(self, x: str, y: str) -> bool:
+        """True if y is a self-ancestor of x (reference: hashgraph.go:131-158)."""
+        if x == y:
+            # Identity holds without store access (the events may be evicted).
+            return True
+        k = (x, y)
+        v, ok = self._self_ancestor_cache.get(k)
+        if ok:
+            return v
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        a = ex.creator() == ey.creator() and ex.index() >= ey.index()
+        self._self_ancestor_cache.add(k, a)
+        return a
+
+    def see(self, x: str, y: str) -> bool:
+        """Fork detection is unnecessary here because insert_event prevents
+        two events at the same height per creator (reference: hashgraph.go:160-169)."""
+        return self.ancestor(x, y)
+
+    def strongly_see(self, x: str, y: str, peers: PeerSet) -> bool:
+        """x strongly sees y: the count of peers p with
+        x.lastAncestors[p] >= y.firstDescendants[p] reaches a super-majority
+        (reference: hashgraph.go:172-206)."""
+        k = (x, y, peers.hash())
+        v, ok = self._strongly_see_cache.get(k)
+        if ok:
+            return v
+        ss = self._strongly_see(x, y, peers)
+        self._strongly_see_cache.add(k, ss)
+        return ss
+
+    def _strongly_see(self, x: str, y: str, peers: PeerSet) -> bool:
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        c = 0
+        for p in peers.pub_keys():
+            xla = ex.last_ancestors.get(p)
+            yfd = ey.first_descendants.get(p)
+            if xla is not None and yfd is not None and xla.index >= yfd.index:
+                c += 1
+        return c >= peers.super_majority()
+
+    # =========================================================================
+    # Round / witness / timestamps
+    # =========================================================================
+
+    def round(self, x: str) -> int:
+        v, ok = self._round_cache.get(x)
+        if ok:
+            return v
+        r = self._round(x)
+        self._round_cache.add(x, r)
+        return r
+
+    def _round(self, x: str) -> int:
+        """Parent round, +1 if x strongly sees a super-majority of
+        parent-round witnesses (reference: hashgraph.go:220-282)."""
+        ex = self.store.get_event(x)
+
+        parent_round = -1
+        if ex.self_parent() != "":
+            parent_round = self.round(ex.self_parent())
+        if ex.other_parent() != "":
+            op_round = self.round(ex.other_parent())
+            if op_round > parent_round:
+                parent_round = op_round
+
+        if parent_round == -1:
+            return 0
+
+        round_ = parent_round
+        parent_round_obj = self.store.get_round(parent_round)
+        parent_round_peer_set = self.store.get_peer_set(parent_round)
+
+        c = 0
+        for w in parent_round_obj.witnesses():
+            if self.strongly_see(x, w, parent_round_peer_set):
+                c += 1
+        if c >= parent_round_peer_set.super_majority():
+            round_ += 1
+        return round_
+
+    def witness(self, x: str) -> bool:
+        v, ok = self._witness_cache.get(x)
+        if ok:
+            return v
+        r = self._witness(x)
+        self._witness_cache.add(x, r)
+        return r
+
+    def _witness(self, x: str) -> bool:
+        """First event of a round for a creator belonging to that round's
+        peer-set (reference: hashgraph.go:297-327)."""
+        ex = self.store.get_event(x)
+        x_round = self.round(x)
+        peer_set = self.store.get_peer_set(x_round)
+        if ex.creator() not in peer_set.by_pub_key:
+            return False
+        sp_round = -1
+        if ex.self_parent() != "":
+            sp_round = self.round(ex.self_parent())
+        return x_round > sp_round
+
+    def round_received(self, x: str) -> int:
+        ex = self.store.get_event(x)
+        return ex.round_received if ex.round_received is not None else -1
+
+    def lamport_timestamp(self, x: str) -> int:
+        v, ok = self._timestamp_cache.get(x)
+        if ok:
+            return v
+        r = self._lamport_timestamp(x)
+        self._timestamp_cache.add(x, r)
+        return r
+
+    def _lamport_timestamp(self, x: str) -> int:
+        """max(parents' timestamps) + 1; an unknown other-parent contributes
+        nothing (reference: hashgraph.go:355-387)."""
+        ex = self.store.get_event(x)
+        plt = -1
+        if ex.self_parent() != "":
+            plt = self.lamport_timestamp(ex.self_parent())
+        if ex.other_parent() != "":
+            try:
+                self.store.get_event(ex.other_parent())
+            except StoreError:
+                pass
+            else:
+                op_lt = self.lamport_timestamp(ex.other_parent())
+                if op_lt > plt:
+                    plt = op_lt
+        return plt + 1
+
+    # =========================================================================
+    # Insert path
+    # =========================================================================
+
+    def _check_self_parent(self, event: Event) -> None:
+        """The self-parent must be the creator's last known event — this is
+        what structurally prevents forks (reference: hashgraph.go:405-429)."""
+        self_parent = event.self_parent()
+        creator = event.creator()
+        try:
+            creator_last_known = self.store.last_event_from(creator)
+        except StoreError as err:
+            if is_store_err(err, StoreErrorKind.EMPTY) and self_parent == "":
+                return  # first event
+            raise SelfParentError(str(err), normal=False)
+        if self_parent != creator_last_known:
+            # Expected under concurrent duplicate inserts — a "normal" error
+            # (reference: errors.go:24-32, hashgraph.go:419-428).
+            raise SelfParentError(
+                "self-parent not last known event by creator", normal=True
+            )
+
+    def _check_other_parent(self, event: Event) -> None:
+        """reference: hashgraph.go:432-442."""
+        other_parent = event.other_parent()
+        if other_parent != "":
+            try:
+                self.store.get_event(other_parent)
+            except StoreError:
+                raise ValueError("other-parent not known")
+
+    def _init_event_coordinates(self, event: Event) -> None:
+        """lastAncestors = element-wise max of parents' lastAncestors;
+        firstDescendants/lastAncestors get the event itself for its creator
+        (reference: hashgraph.go:445-483)."""
+        event.last_ancestors = {}
+        event.first_descendants = {}
+
+        self_parent: Optional[Event] = None
+        other_parent: Optional[Event] = None
+        try:
+            self_parent = self.store.get_event(event.self_parent())
+        except StoreError:
+            pass
+        try:
+            other_parent = self.store.get_event(event.other_parent())
+        except StoreError:
+            pass
+
+        if self_parent is None and other_parent is not None:
+            event.last_ancestors = dict(other_parent.last_ancestors)
+        elif other_parent is None and self_parent is not None:
+            event.last_ancestors = dict(self_parent.last_ancestors)
+        elif self_parent is not None and other_parent is not None:
+            event.last_ancestors = dict(self_parent.last_ancestors)
+            for p, ola in other_parent.last_ancestors.items():
+                sla = event.last_ancestors.get(p)
+                if sla is None or sla.index < ola.index:
+                    event.last_ancestors[p] = EventCoordinates(ola.hash, ola.index)
+
+        me = EventCoordinates(event.hex(), event.index())
+        event.first_descendants[event.creator()] = me
+        event.last_ancestors[event.creator()] = me
+
+    def _update_ancestor_first_descendant(self, event: Event) -> None:
+        """Walk each last-ancestor's self-parent chain, recording this event
+        as first descendant, stopping at witnesses or already-filled entries
+        (reference: hashgraph.go:486-519)."""
+        creator = event.creator()
+        coords = EventCoordinates(event.hex(), event.index())
+        for c in list(event.last_ancestors.values()):
+            ah = c.hash
+            while True:
+                try:
+                    a = self.store.get_event(ah)
+                except StoreError:
+                    break
+                if creator not in a.first_descendants:
+                    a.first_descendants[creator] = coords
+                    self.store.set_event(a)
+                    # Stop at witnesses so the walk doesn't descend to the
+                    # bottom of the graph (reference: hashgraph.go:503-512).
+                    try:
+                        if self.witness(ah):
+                            break
+                    except StoreError:
+                        pass
+                    ah = a.self_parent()
+                else:
+                    break
+
+    def set_wire_info(self, event: Event) -> None:
+        """Fill the (creatorID, parent index) wire fields
+        (reference: hashgraph.go:596-633)."""
+        self_parent_index = -1
+        other_parent_creator_id = 0
+        other_parent_index = -1
+
+        creator = self.store.repertoire_by_pub_key().get(event.creator())
+        if creator is None:
+            raise ValueError(f"creator {event.creator()} not found")
+
+        if event.self_parent() != "":
+            self_parent_index = self.store.get_event(event.self_parent()).index()
+
+        if event.other_parent() != "":
+            other_parent = self.store.get_event(event.other_parent())
+            op_creator = self.store.repertoire_by_pub_key().get(other_parent.creator())
+            if op_creator is None:
+                raise ValueError(f"creator {other_parent.creator()} not found")
+            other_parent_creator_id = op_creator.id
+            other_parent_index = other_parent.index()
+
+        event.set_wire_info(
+            self_parent_index,
+            other_parent_creator_id,
+            other_parent_index,
+            creator.id,
+        )
+
+    def insert_event_and_run_consensus(
+        self, event: Event, set_wire_info: bool = False
+    ) -> None:
+        """The per-event pipeline driver (reference: hashgraph.go:644-668)."""
+        self.insert_event(event, set_wire_info)
+        self.divide_rounds()
+        self.decide_fame()
+        self.decide_round_received()
+        self.process_decided_rounds()
+
+    def insert_event(self, event: Event, set_wire_info: bool = False) -> None:
+        """Verify signature, check parents, prevent forks, maintain
+        coordinates, queue for consensus (reference: hashgraph.go:672-750)."""
+        if not event.verify():
+            raise ValueError(f"invalid event signature {event.hex()}")
+
+        self._check_self_parent(event)
+        self._check_other_parent(event)
+
+        event.topological_index = self.topological_index
+        self.topological_index += 1
+
+        if set_wire_info:
+            self.set_wire_info(event)
+
+        self._init_event_coordinates(event)
+        self.store.set_event(event)
+        self._update_ancestor_first_descendant(event)
+
+        self.undetermined_events.append(event.hex())
+
+        if event.is_loaded():
+            self.pending_loaded_events += 1
+
+        for bs in event.block_signatures():
+            self.pending_signatures.add(bs)
+
+    def insert_frame_event(self, frame_event: FrameEvent) -> None:
+        """Trusted insert for fast-sync: skips signature/parent checks, primes
+        the round/witness/timestamp caches, records as consensus event
+        (reference: hashgraph.go:754-802)."""
+        event = frame_event.core
+
+        self._round_cache.add(event.hex(), frame_event.round)
+        self._witness_cache.add(event.hex(), frame_event.witness)
+        self._timestamp_cache.add(event.hex(), frame_event.lamport_timestamp)
+
+        event.set_round(frame_event.round)
+        event.set_lamport_timestamp(frame_event.lamport_timestamp)
+
+        try:
+            round_info = self.store.get_round(frame_event.round)
+        except StoreError as err:
+            if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
+                raise
+            round_info = RoundInfo()
+        round_info.add_created_event(event.hex(), frame_event.witness)
+        self.store.set_round(frame_event.round, round_info)
+
+        self._init_event_coordinates(event)
+        self.store.set_event(event)
+        self._update_ancestor_first_descendant(event)
+        self.store.add_consensus_event(event)
+
+    # =========================================================================
+    # Consensus pipeline
+    # =========================================================================
+
+    def divide_rounds(self) -> None:
+        """Assign round + Lamport timestamp to undetermined events, flag
+        witnesses, queue pending rounds (reference: hashgraph.go:807-872)."""
+        for hash_ in self.undetermined_events:
+            ev = self.store.get_event(hash_)
+            update_event = False
+
+            if ev.round is None:
+                round_number = self.round(hash_)
+                ev.set_round(round_number)
+                update_event = True
+
+                try:
+                    round_info = self.store.get_round(round_number)
+                except StoreError as err:
+                    if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
+                        raise
+                    round_info = RoundInfo()
+
+                if (
+                    not self.pending_rounds.queued(round_number)
+                    and not round_info.decided
+                    and (
+                        self.round_lower_bound is None
+                        or round_number > self.round_lower_bound
+                    )
+                ):
+                    self.pending_rounds.set(PendingRound(round_number, False))
+
+                round_info.add_created_event(hash_, self.witness(hash_))
+                self.store.set_round(round_number, round_info)
+
+            if ev.lamport_timestamp is None:
+                ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
+                update_event = True
+
+            if update_event:
+                self.store.set_event(ev)
+
+    def decide_fame(self) -> None:
+        """Virtual voting with coin rounds every COIN_ROUND_FREQ rounds
+        (reference: hashgraph.go:875-998)."""
+        votes: Dict[str, Dict[str, bool]] = {}  # votes[y][x] = y's vote on x
+
+        def set_vote(y: str, x: str, vote: bool) -> None:
+            votes.setdefault(y, {})[x] = vote
+
+        decided_rounds: List[int] = []
+
+        for pr in self.pending_rounds.get_ordered_pending_rounds():
+            round_index = pr.index
+            r_round_info = self.store.get_round(round_index)
+            r_peer_set = self.store.get_peer_set(round_index)
+
+            for x in r_round_info.witnesses():
+                if r_round_info.is_decided(x):
+                    continue
+                done = False
+                for j in range(round_index + 1, self.store.last_round() + 1):
+                    if done:
+                        break
+                    j_round_info = self.store.get_round(j)
+                    j_peer_set = self.store.get_peer_set(j)
+
+                    for y in j_round_info.witnesses():
+                        diff = j - round_index
+                        if diff == 1:
+                            set_vote(y, x, self.see(y, x))
+                        else:
+                            j_prev_round_info = self.store.get_round(j - 1)
+                            j_prev_peer_set = self.store.get_peer_set(j - 1)
+
+                            # Witnesses of round j-1 strongly seen by y,
+                            # based on the round j-1 peer-set.
+                            ss_witnesses = [
+                                w
+                                for w in j_prev_round_info.witnesses()
+                                if self.strongly_see(y, w, j_prev_peer_set)
+                            ]
+
+                            yays = 0
+                            nays = 0
+                            for w in ss_witnesses:
+                                if votes.get(w, {}).get(x, False):
+                                    yays += 1
+                                else:
+                                    nays += 1
+                            v = False
+                            t = nays
+                            if yays >= nays:
+                                v = True
+                                t = yays
+
+                            if diff % COIN_ROUND_FREQ > 0:  # normal round
+                                if t >= j_peer_set.super_majority():
+                                    r_round_info.set_fame(x, v)
+                                    set_vote(y, x, v)
+                                    done = True  # break out of the j loop
+                                    break
+                                set_vote(y, x, v)
+                            else:  # coin round
+                                if t >= j_peer_set.super_majority():
+                                    set_vote(y, x, v)
+                                else:
+                                    set_vote(y, x, middle_bit(y))
+
+            if r_round_info.witnesses_decided(r_peer_set):
+                decided_rounds.append(round_index)
+
+            self.store.set_round(round_index, r_round_info)
+
+        self.pending_rounds.update(decided_rounds)
+
+    def decide_round_received(self) -> None:
+        """An event is received at the first decided round whose famous
+        witnesses ALL see it (reference: hashgraph.go:1002-1095, quoting the
+        whitepaper's 18/03/18 formulation)."""
+        new_undetermined: List[str] = []
+
+        for x in self.undetermined_events:
+            received = False
+            r = self.round(x)
+
+            for i in range(r + 1, self.store.last_round() + 1):
+                try:
+                    tr = self.store.get_round(i)
+                except StoreError:
+                    # A joiner's first event can have round 0 while others
+                    # have long evicted round 1 (reference: hashgraph.go:1019-1026).
+                    break
+
+                t_peers = self.store.get_peer_set(i)
+
+                if not tr.witnesses_decided(t_peers):
+                    # Rounds below the fast-sync lower bound are never decided
+                    # by decide_fame — skip them instead of bailing
+                    # (reference: hashgraph.go:1033-1046).
+                    if self.round_lower_bound is None or self.round_lower_bound < i:
+                        break
+                    else:
+                        continue
+
+                fws = tr.famous_witnesses()
+                s = [w for w in fws if self.see(w, x)]
+
+                if len(s) == len(fws) and len(s) >= t_peers.super_majority():
+                    received = True
+                    ex = self.store.get_event(x)
+                    ex.set_round_received(i)
+                    self.store.set_event(ex)
+                    tr.add_received_event(x)
+                    self.store.set_round(i, tr)
+                    break
+
+            if not received:
+                new_undetermined.append(x)
+
+        self.undetermined_events = new_undetermined
+
+    def process_decided_rounds(self) -> None:
+        """Map decided rounds onto Frames and Blocks, committing via the
+        callback (reference: hashgraph.go:1100-1181)."""
+        processed_rounds: List[int] = []
+        try:
+            for pr in self.pending_rounds.get_ordered_pending_rounds():
+                # Never process a decided round before all earlier rounds are
+                # processed (reference: hashgraph.go:1108-1113).
+                if not pr.decided:
+                    break
+
+                frame = self.get_frame(pr.index)
+
+                if frame.events:
+                    for fe in frame.events:
+                        self.store.add_consensus_event(fe.core)
+                        self.consensus_transactions += len(fe.core.transactions())
+                        if fe.core.is_loaded():
+                            self.pending_loaded_events -= 1
+
+                    block = Block.from_frame(self.store.last_block_index() + 1, frame)
+                    if block.transactions() or block.internal_transactions():
+                        self.store.set_block(block)
+                        try:
+                            self.commit_callback(block)
+                        except Exception:
+                            # Commit failures are non-fatal (the reference
+                            # logs a warning and carries on, hashgraph.go:1162-1165).
+                            logger.warning(
+                                "failed to commit block %d", block.index(), exc_info=True
+                            )
+                    self.last_committed_round_events = len(frame.events)
+
+                processed_rounds.append(pr.index)
+
+                if (
+                    self.last_consensus_round is None
+                    or pr.index > self.last_consensus_round
+                ):
+                    self._set_last_consensus_round(pr.index)
+        finally:
+            self.pending_rounds.clean(processed_rounds)
+
+    # =========================================================================
+    # Frames
+    # =========================================================================
+
+    def _create_frame_event(self, x: str) -> FrameEvent:
+        """reference: hashgraph.go:521-557."""
+        ev = self.store.get_event(x)
+        round_ = self.round(x)
+        round_info = self.store.get_round(round_)
+        te = round_info.created_events.get(x)
+        if te is None:
+            raise ValueError(f"round {round_} created_events[{x}] not found")
+        return FrameEvent(
+            core=ev,
+            round=round_,
+            lamport_timestamp=self.lamport_timestamp(x),
+            witness=te.witness,
+        )
+
+    def _create_root(self, participant: str, head: str) -> Root:
+        """Root = the head + up to ROOT_DEPTH prior events of the
+        participant, in topological order (reference: hashgraph.go:559-594)."""
+        root = Root()
+        if head != "":
+            head_event = self._create_frame_event(head)
+            reverse_root_events = [head_event]
+            index = head_event.core.index()
+            for _ in range(ROOT_DEPTH):
+                index -= 1
+                if index < 0:
+                    break
+                try:
+                    peh = self.store.participant_event(participant, index)
+                except StoreError:
+                    break
+                reverse_root_events.append(self._create_frame_event(peh))
+            for fe in reversed(reverse_root_events):
+                root.insert(fe)
+        return root
+
+    def get_frame(self, round_received: int) -> Frame:
+        """Compute (or fetch) the Frame of a received round
+        (reference: hashgraph.go:1184-1289)."""
+        try:
+            return self.store.get_frame(round_received)
+        except StoreError as err:
+            if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
+                raise
+
+        round_ = self.store.get_round(round_received)
+        peer_set = self.store.get_peer_set(round_received)
+
+        events = [self._create_frame_event(eh) for eh in round_.received_events]
+        events = sort_frame_events(events)
+
+        # Roots for participants with events in this frame: built from each
+        # participant's first frame-event's self-parent.
+        roots: Dict[str, Root] = {}
+        for fe in events:
+            p = fe.core.creator()
+            if p not in roots:
+                roots[p] = self._create_root(p, fe.core.self_parent())
+
+        # Every participant known before round_received needs a Root —
+        # built from its last consensus event (reference: hashgraph.go:1231-1256).
+        for p, peer in self.store.repertoire_by_pub_key().items():
+            first_round, ok = self.store.first_round(peer.id)
+            if not ok or first_round > round_received:
+                continue
+            if p not in roots:
+                last_consensus_event_hash = self.store.last_consensus_event_from(p)
+                roots[p] = self._create_root(p, last_consensus_event_hash)
+
+        all_peer_sets = self.store.get_all_peer_sets()
+
+        # BFT timestamp: median of famous-witness wall-clock timestamps
+        # (reference: hashgraph.go:1264-1273).
+        timestamps = [
+            self.store.get_event(fw).timestamp()
+            for fw in round_.famous_witnesses()
+        ]
+        frame_timestamp = median_int(timestamps)
+
+        res = Frame(
+            round=round_received,
+            peers=peer_set,
+            roots=roots,
+            events=events,
+            peer_sets=all_peer_sets,
+            timestamp=frame_timestamp,
+        )
+        self.store.set_frame(res)
+        return res
+
+    # =========================================================================
+    # Signature pool / anchor block
+    # =========================================================================
+
+    def process_sig_pool(self) -> None:
+        """Match pending block-signatures to stored blocks; validate the
+        signer against the block round's peer-set; verify; append
+        (reference: hashgraph.go:1295-1367)."""
+        for bs in self.pending_signatures.slice():
+            try:
+                block = self.store.get_block(bs.index)
+            except StoreError:
+                continue  # block not yet committed locally; keep the sig
+
+            try:
+                peer_set = self.store.get_peer_set(block.round_received())
+            except StoreError:
+                continue
+
+            if bs.validator_hex() not in peer_set.by_pub_key:
+                continue  # signer not a validator for that round: drop later
+
+            if not block.verify_signature(bs):
+                continue
+
+            block.set_signature(bs)
+            self.store.set_block(block)
+            self.set_anchor_block(block)
+            self.pending_signatures.remove(bs.key())
+
+    def set_anchor_block(self, block: Block) -> None:
+        """AnchorBlock = latest block with MORE than 1/3 signatures
+        (reference: hashgraph.go:1375-1408)."""
+        peer_set = self.store.get_peer_set(block.round_received())
+        if len(block.signatures) > peer_set.trust_count() and (
+            self.anchor_block is None or block.index() > self.anchor_block
+        ):
+            self.anchor_block = block.index()
+
+    def get_anchor_block_with_frame(self) -> tuple[Block, Frame]:
+        """reference: hashgraph.go:1412-1428."""
+        if self.anchor_block is None:
+            raise ValueError("no anchor block")
+        block = self.store.get_block(self.anchor_block)
+        frame = self.get_frame(block.round_received())
+        return block, frame
+
+    # =========================================================================
+    # Reset / bootstrap
+    # =========================================================================
+
+    def reset(self, block: Block, frame: Frame) -> None:
+        """Re-base the hashgraph from a frame (fast-sync landing)
+        (reference: hashgraph.go:1431-1470)."""
+        self.last_consensus_round = None
+        self.first_consensus_round = None
+        self.anchor_block = None
+        self.undetermined_events = []
+        self.pending_rounds = PendingRoundsCache()
+        self.pending_loaded_events = 0
+        self.topological_index = 0
+
+        cs = self.store.cache_size()
+        self._ancestor_cache = LRU(cs)
+        self._self_ancestor_cache = LRU(cs)
+        self._strongly_see_cache = LRU(cs)
+        self._round_cache = LRU(cs)
+        self._timestamp_cache = LRU(cs)
+        self._witness_cache = LRU(cs)
+
+        self.store.reset(frame)
+
+        for fe in frame.sorted_frame_events():
+            self.insert_frame_event(fe)
+
+        self.store.set_block(block)
+        self._set_last_consensus_round(block.round_received())
+        self.round_lower_bound = block.round_received()
+
+    def bootstrap(self) -> None:
+        """Replay a persistent store's events through consensus in
+        topological order — only from index 0 (reference: hashgraph.go:1481-1536).
+        The persistent store provides topological_events(); InmemStore has
+        nothing to replay."""
+        topo = getattr(self.store, "topological_events", None)
+        if topo is None:
+            return
+        maintenance = getattr(self.store, "set_maintenance_mode", None)
+        if maintenance is not None:
+            maintenance(True)
+        try:
+            batch_size = 100
+            index = 0
+            while True:
+                events = topo(index * batch_size, batch_size)
+                for e in events:
+                    self.insert_event_and_run_consensus(e, set_wire_info=True)
+                self.process_sig_pool()
+                if len(events) < batch_size:
+                    break
+                index += 1
+        finally:
+            if maintenance is not None:
+                maintenance(False)
+
+    # =========================================================================
+    # Wire conversion / block checks
+    # =========================================================================
+
+    def read_wire_info(self, wevent: WireEvent) -> Event:
+        """WireEvent → Event: resolve (creatorID, index) pairs back to
+        parent hashes via the participant indexes (reference: hashgraph.go:1540-1595)."""
+        self_parent = ""
+        other_parent = ""
+
+        creator = self.store.repertoire_by_id().get(wevent.body.creator_id)
+        if creator is None:
+            raise ValueError(f"creator {wevent.body.creator_id} not found")
+        creator_bytes = creator.pub_key_bytes()
+
+        if wevent.body.self_parent_index >= 0:
+            self_parent = self.store.participant_event(
+                creator.pub_key_hex, wevent.body.self_parent_index
+            )
+
+        if wevent.body.other_parent_index >= 0:
+            op_creator = self.store.repertoire_by_id().get(
+                wevent.body.other_parent_creator_id
+            )
+            if op_creator is None:
+                raise ValueError(
+                    f"participant {wevent.body.other_parent_creator_id} not found"
+                )
+            other_parent = self.store.participant_event(
+                op_creator.pub_key_hex, wevent.body.other_parent_index
+            )
+
+        body = EventBody(
+            transactions=wevent.body.transactions,
+            internal_transactions=wevent.body.internal_transactions,
+            block_signatures=wevent.block_signatures(creator_bytes),
+            parents=[self_parent, other_parent],
+            creator=creator_bytes,
+            index=wevent.body.index,
+            timestamp=wevent.body.timestamp,
+            self_parent_index=wevent.body.self_parent_index,
+            other_parent_creator_id=wevent.body.other_parent_creator_id,
+            other_parent_index=wevent.body.other_parent_index,
+            creator_id=wevent.body.creator_id,
+        )
+        return Event(body, signature=wevent.signature)
+
+    def check_block(self, block: Block, peer_set: PeerSet) -> None:
+        """Validate a block carries MORE than 1/3 valid signatures from the
+        given peer-set (reference: hashgraph.go:1599-1630)."""
+        if peer_set.hash() != block.peers_hash():
+            raise ValueError("wrong peer-set")
+        valid = 0
+        for s in block.get_signatures():
+            if s.validator_hex() not in peer_set.by_pub_key:
+                continue
+            if block.verify_signature(s):
+                valid += 1
+        if valid <= peer_set.trust_count():
+            raise ValueError(
+                f"not enough valid signatures: got {valid}, "
+                f"need more than {peer_set.trust_count()}"
+            )
+
+    # =========================================================================
+    # Setters
+    # =========================================================================
+
+    def _set_last_consensus_round(self, i: int) -> None:
+        self.last_consensus_round = i
+        if self.first_consensus_round is None:
+            self.first_consensus_round = i

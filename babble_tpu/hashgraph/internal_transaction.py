@@ -1,0 +1,117 @@
+"""InternalTransaction — signed peer-membership changes that go through
+consensus (reference: src/hashgraph/internal_transaction.go:20-189)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.crypto.hashing import sha256
+from babble_tpu.crypto.keys import PrivateKey
+from babble_tpu.peers.peer import Peer
+
+
+class TransactionType(enum.IntEnum):
+    """reference: internal_transaction.go:20-25."""
+
+    PEER_ADD = 0
+    PEER_REMOVE = 1
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass
+class InternalTransactionBody:
+    """reference: internal_transaction.go:40-43."""
+
+    type: TransactionType
+    peer: Peer
+
+    def to_dict(self) -> dict:
+        return {"Type": int(self.type), "Peer": self.peer.to_dict()}
+
+    def hash(self) -> bytes:
+        return sha256(canonical_dumps(self.to_dict()))
+
+    @staticmethod
+    def from_dict(d: dict) -> "InternalTransactionBody":
+        return InternalTransactionBody(
+            type=TransactionType(d["Type"]), peer=Peer.from_dict(d["Peer"])
+        )
+
+
+@dataclass
+class InternalTransaction:
+    """reference: internal_transaction.go:72-75."""
+
+    body: InternalTransactionBody
+    signature: str = ""
+
+    @staticmethod
+    def join(peer: Peer) -> "InternalTransaction":
+        return InternalTransaction(InternalTransactionBody(TransactionType.PEER_ADD, peer))
+
+    @staticmethod
+    def leave(peer: Peer) -> "InternalTransaction":
+        return InternalTransaction(
+            InternalTransactionBody(TransactionType.PEER_REMOVE, peer)
+        )
+
+    def sign(self, key: PrivateKey) -> None:
+        """The *target peer's* key signs the body — joins are self-requested
+        (reference: internal_transaction.go:122-136)."""
+        self.signature = key.sign(self.body.hash())
+
+    def verify(self) -> bool:
+        """reference: internal_transaction.go:139-154."""
+        try:
+            pub = self.body.peer.public_key()
+        except Exception:
+            return False
+        return pub.verify(self.body.hash(), self.signature)
+
+    def hash_string(self) -> str:
+        """Key for tracking itxs through consensus
+        (reference: internal_transaction.go:159-162)."""
+        return self.body.hash().hex()
+
+    def as_accepted(self) -> "InternalTransactionReceipt":
+        return InternalTransactionReceipt(self, True)
+
+    def as_refused(self) -> "InternalTransactionReceipt":
+        return InternalTransactionReceipt(self, False)
+
+    def to_dict(self) -> dict:
+        return {"Body": self.body.to_dict(), "Signature": self.signature}
+
+    @staticmethod
+    def from_dict(d: dict) -> "InternalTransaction":
+        return InternalTransaction(
+            body=InternalTransactionBody.from_dict(d["Body"]),
+            signature=d.get("Signature", ""),
+        )
+
+
+@dataclass
+class InternalTransactionReceipt:
+    """App's accept/refuse decision (reference: internal_transaction.go:186-189)."""
+
+    internal_transaction: InternalTransaction
+    accepted: bool
+
+    def to_dict(self) -> dict:
+        return {
+            "InternalTransaction": self.internal_transaction.to_dict(),
+            "Accepted": self.accepted,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "InternalTransactionReceipt":
+        return InternalTransactionReceipt(
+            internal_transaction=InternalTransaction.from_dict(
+                d["InternalTransaction"]
+            ),
+            accepted=d["Accepted"],
+        )
