@@ -1,0 +1,9 @@
+"""TPU compute kernels (JAX/XLA) for the consensus hot loops.
+
+- ``babble_tpu.ops.dag`` — tensorized DAG pipeline: stronglySee, round
+  assignment, virtual voting, round-received. Replaces the per-event
+  recursive predicates of the CPU oracle (reference hot loops:
+  src/hashgraph/hashgraph.go:172-206, 807-998, 1002-1095).
+- ``babble_tpu.ops.verify`` — batched secp256k1 signature verification
+  (replaces per-event Verify, reference: src/hashgraph/event.go:219-247).
+"""

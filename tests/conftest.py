@@ -7,7 +7,11 @@ before anything imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize force-registers the TPU backend whenever
+# PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS — clear it so the
+# virtual CPU mesh wins under pytest.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,96 @@
+"""Differential tests: tensorized DAG pipeline (babble_tpu.ops.dag) vs the
+CPU oracle (babble_tpu.hashgraph.Hashgraph) on the golden play-script DAGs.
+
+Every predicate and pipeline stage must agree exactly with the oracle —
+which itself is pinned to the reference by tests/test_hashgraph.py."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from babble_tpu.common.trilean import Trilean
+from babble_tpu.ops import dag as dag_ops
+
+from tests.test_hashgraph import (
+    BASIC_PLAYS,
+    CONSENSUS_PLAYS,
+    ROUND_PLAYS,
+    init_full,
+    init_funky,
+    init_sparse,
+)
+
+
+def _oracle_and_snapshot(builder):
+    h, index, nodes, peer_set = builder()
+    h.divide_rounds()
+    h.decide_fame()
+    h.decide_round_received()
+    snapshot = dag_ops.snapshot_from_hashgraph(h)
+    return h, index, snapshot
+
+
+BUILDERS = {
+    "basic": lambda: init_full(BASIC_PLAYS, 3),
+    "round": lambda: init_full(ROUND_PLAYS, 3),
+    "consensus": lambda: init_full(CONSENSUS_PLAYS, 3),
+    "funky": lambda: init_funky(False),
+    "funky_full": lambda: init_funky(True),
+    "sparse": lambda: init_sparse(),
+}
+
+
+@pytest.mark.parametrize("graph", list(BUILDERS))
+def test_pipeline_matches_oracle(graph):
+    h, index, snapshot = _oracle_and_snapshot(BUILDERS[graph])
+    out = dag_ops.run_pipeline(snapshot)
+    hashes = snapshot.hashes
+    E = len(hashes)
+    peer_set = h.store.get_peer_set(0)
+
+    # --- see / strongly-see matrices
+    for x in range(E):
+        for y in range(E):
+            assert out["see"][x, y] == h.see(hashes[x], hashes[y]), (
+                f"see mismatch at ({x},{y})"
+            )
+            assert out["strongly_see"][x, y] == h.strongly_see(
+                hashes[x], hashes[y], peer_set
+            ), f"stronglySee mismatch at ({x},{y})"
+
+    # --- rounds / witness / lamport
+    for i, eh in enumerate(hashes):
+        assert out["rounds"][i] == h.round(eh), f"round mismatch at {i}"
+        assert out["witness"][i] == h.witness(eh), f"witness mismatch at {i}"
+        assert out["lamport"][i] == h.lamport_timestamp(eh), f"lamport @ {i}"
+
+    # --- fame
+    fame_oracle = {}
+    for r in range(h.store.last_round() + 1):
+        ri = h.store.get_round(r)
+        for x, e in ri.created_events.items():
+            if e.witness:
+                fame_oracle[x] = e.famous
+    for i, eh in enumerate(hashes):
+        if eh in fame_oracle:
+            expected = {
+                Trilean.TRUE: 1,
+                Trilean.FALSE: -1,
+                Trilean.UNDEFINED: 0,
+            }[fame_oracle[eh]]
+            assert out["fame"][i] == expected, f"fame mismatch at {i}"
+
+    # --- round received
+    for i, eh in enumerate(hashes):
+        ev = h.store.get_event(eh)
+        expected_rr = ev.round_received if ev.round_received is not None else -1
+        assert out["round_received"][i] == expected_rr, f"rr mismatch at {i}"
+
+
+def test_jit_compiles_once():
+    """The pipeline is one jitted XLA program over static shapes."""
+    _, _, snapshot = _oracle_and_snapshot(BUILDERS["basic"])
+    out1 = dag_ops.run_pipeline(snapshot)
+    out2 = dag_ops.run_pipeline(snapshot)
+    np.testing.assert_array_equal(out1["rounds"], out2["rounds"])
