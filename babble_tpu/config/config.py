@@ -1,0 +1,81 @@
+"""Configuration for a node, with the reference's defaults.
+
+Reference semantics: src/config/config.go:34-56 (defaults),
+config/config.go:58-197 (fields), config/config.go:287-308 (datadir
+conventions). Durations are seconds (float) rather than Go durations.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+
+DEFAULT_KEYFILE = "priv_key"
+DEFAULT_BADGER_DIR = "badger_db"
+DEFAULT_PEERS_FILE = "peers.json"
+DEFAULT_GENESIS_PEERS_FILE = "peers.genesis.json"
+
+
+def default_data_dir() -> str:
+    """~/.babble equivalent (reference: config/config.go:287-297)."""
+    return os.path.join(os.path.expanduser("~"), ".babble_tpu")
+
+
+@dataclass
+class Config:
+    """Node configuration (reference: config/config.go:58-197)."""
+
+    data_dir: str = field(default_factory=default_data_dir)
+    log_level: str = "info"
+
+    bind_addr: str = "127.0.0.1:1337"
+    advertise_addr: str = ""
+    service_addr: str = "127.0.0.1:8000"
+    no_service: bool = False
+
+    heartbeat_timeout: float = 0.010  # 10 ms busy gossip cadence
+    slow_heartbeat_timeout: float = 1.0  # idle gossip cadence
+    tcp_timeout: float = 1.0
+    join_timeout: float = 10.0
+
+    max_pool: int = 2
+    cache_size: int = 10000
+    sync_limit: int = 1000
+    suspend_limit: int = 100
+
+    enable_fast_sync: bool = False
+    store: bool = False  # persistent store (SQLite-backed) vs in-memory
+    database_dir: str = ""
+    bootstrap: bool = False
+    maintenance_mode: bool = False
+    moniker: str = ""
+
+    # TPU acceleration: route batch verification and the DAG consensus
+    # sweeps through the JAX kernels in babble_tpu.ops.
+    accelerator: bool = False
+
+    def __post_init__(self) -> None:
+        if not self.database_dir:
+            self.database_dir = os.path.join(self.data_dir, DEFAULT_BADGER_DIR)
+        # Option forcing (reference: babble/babble.go:133-143):
+        # maintenance-mode implies bootstrap, bootstrap implies store.
+        if self.maintenance_mode:
+            self.bootstrap = True
+        if self.bootstrap:
+            self.store = True
+
+    def keyfile_path(self) -> str:
+        return os.path.join(self.data_dir, DEFAULT_KEYFILE)
+
+    def peers_path(self) -> str:
+        return os.path.join(self.data_dir, DEFAULT_PEERS_FILE)
+
+    def genesis_peers_path(self) -> str:
+        return os.path.join(self.data_dir, DEFAULT_GENESIS_PEERS_FILE)
+
+    def logger(self, name: str = "babble_tpu") -> logging.Logger:
+        logger = logging.getLogger(f"{name}.{self.moniker or 'node'}")
+        logger.setLevel(getattr(logging, self.log_level.upper(), logging.INFO))
+        return logger

@@ -1,0 +1,32 @@
+"""Transport layer: point-to-point request/response RPC between nodes
+(reference: src/net/)."""
+
+from .rpc import (
+    RPC,
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    JoinRequest,
+    JoinResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from .transport import Transport, TransportError
+from .inmem import InmemNetwork, InmemTransport
+
+__all__ = [
+    "RPC",
+    "SyncRequest",
+    "SyncResponse",
+    "EagerSyncRequest",
+    "EagerSyncResponse",
+    "FastForwardRequest",
+    "FastForwardResponse",
+    "JoinRequest",
+    "JoinResponse",
+    "Transport",
+    "TransportError",
+    "InmemNetwork",
+    "InmemTransport",
+]

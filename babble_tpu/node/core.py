@@ -1,0 +1,405 @@
+"""Core: the node's façade over the hashgraph.
+
+Reference semantics: src/node/core.go — head/seq tracking (:143-177),
+sync + heads-merge (:210-289), addSelfEvent (:292-333), commit callback
+(:486-537), accepted-internal-transaction processing with the +6
+effective-round rule (:562-650), eventDiff (:660-703), pools (:740-758).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..hashgraph.block import Block
+from ..hashgraph.errors import is_normal_self_parent_error
+from ..hashgraph.event import Event, WireEvent, sort_topological
+from ..hashgraph.frame import Frame
+from ..hashgraph.hashgraph import Hashgraph
+from ..hashgraph.internal_transaction import (
+    InternalTransaction,
+    InternalTransactionReceipt,
+    TransactionType,
+)
+from ..hashgraph.store import Store
+from ..peers.peer_set import PeerSet
+from .peer_selector import RandomPeerSelector
+from .promise import JoinPromise
+from .validator import Validator
+
+logger = logging.getLogger(__name__)
+
+# All consistent hashgraphs will have decided the fame of round r witnesses
+# by round r+5, so a new peer-set becomes effective at round r+6 (whitepaper
+# lemmas 5.15 and 5.17; reference: core.go:566-569).
+PEER_SET_EFFECTIVE_DELAY = 6
+
+
+class Core:
+    """reference: core.go:19-100."""
+
+    def __init__(
+        self,
+        validator: Validator,
+        peers: PeerSet,
+        genesis_peers: PeerSet,
+        store: Store,
+        proxy_commit_callback: Callable[[Block], object],
+        maintenance_mode: bool = False,
+    ):
+        self.validator = validator
+        self.genesis_peers = genesis_peers
+        self.validators = genesis_peers
+        self.peers = peers
+        self.peer_selector = RandomPeerSelector(peers, validator.id())
+        self.proxy_commit_callback = proxy_commit_callback
+        self.maintenance_mode = maintenance_mode
+
+        self.head: str = ""
+        self.seq: int = -1
+
+        self.accepted_round: int = -1
+        self.removed_round: int = -1
+        self.target_round: int = -1
+        self.last_peer_change_round: int = -1
+
+        # Other-peers' head events awaiting inclusion as self-events
+        # (reference: core.go:66-73).
+        self.heads: Dict[int, Optional[Event]] = {}
+
+        self.transaction_pool: List[bytes] = []
+        self.internal_transaction_pool: List[InternalTransaction] = []
+        self.self_block_signatures = {}  # key -> BlockSignature
+        self.promises: Dict[str, JoinPromise] = {}
+
+        self.hg = Hashgraph(store, self.commit)
+        self.hg.init(genesis_peers)
+
+    # -- head/seq -----------------------------------------------------------
+
+    def set_head_and_seq(self) -> None:
+        """reference: core.go:143-177."""
+        head = ""
+        seq = -1
+        if self.validator.id() in self.hg.store.repertoire_by_id():
+            try:
+                last = self.hg.store.last_event_from(self.validator.public_key_hex())
+            except Exception:
+                last = ""
+            if last:
+                head = last
+                seq = self.hg.store.get_event(last).index()
+        self.head = head
+        self.seq = seq
+
+    def bootstrap(self) -> None:
+        self.hg.bootstrap()
+
+    def set_peers(self, ps: PeerSet) -> None:
+        """reference: core.go:185-188."""
+        self.peers = ps
+        self.peer_selector = RandomPeerSelector(ps, self.validator.id())
+
+    # -- busy ---------------------------------------------------------------
+
+    def busy(self) -> bool:
+        """Unfinished work gates the fast heartbeat
+        (reference: core.go:196-202)."""
+        return (
+            self.hg.pending_loaded_events > 0
+            or len(self.transaction_pool) > 0
+            or len(self.internal_transaction_pool) > 0
+            or len(self.self_block_signatures) > 0
+            or (
+                self.hg.last_consensus_round is not None
+                and self.hg.last_consensus_round < self.target_round
+            )
+        )
+
+    # -- sync ---------------------------------------------------------------
+
+    def sync(self, from_id: int, unknown_events: List[WireEvent]) -> None:
+        """Insert wire events (topological order expected), track the other
+        peer's head, and record a new self-event when busy
+        (reference: core.go:210-289)."""
+        other_head: Optional[Event] = None
+        for we in unknown_events:
+            ev = self.hg.read_wire_info(we)
+            try:
+                self.insert_event_and_run_consensus(ev, set_wire_info=False)
+            except Exception as err:
+                if is_normal_self_parent_error(err):
+                    # Benign concurrent-duplicate-insert race.
+                    continue
+                raise
+
+            if we.body.creator_id == from_id:
+                other_head = ev
+
+            stale = self.heads.get(we.body.creator_id)
+            if stale is not None and we.body.index > stale.index():
+                del self.heads[we.body.creator_id]
+
+        # Do not overwrite a non-empty head with an empty one
+        # (reference: core.go:246-252).
+        existing = self.heads.get(from_id)
+        if (
+            from_id not in self.heads
+            or existing is None
+            or (other_head is not None and other_head.index() > existing.index())
+        ):
+            self.heads[from_id] = other_head
+
+        # Only record a new self-event when there is something to say
+        # (reference: core.go:264-270).
+        if self.busy() or self.seq < 0:
+            self.record_heads()
+
+    def record_heads(self) -> None:
+        """reference: core.go:274-289."""
+        for fid in list(self.heads.keys()):
+            ev = self.heads[fid]
+            self.add_self_event(ev.hex() if ev is not None else "")
+            del self.heads[fid]
+
+    def add_self_event(self, other_head: str) -> None:
+        """Package the pools into a new head event
+        (reference: core.go:292-333)."""
+        if self.hg.store.last_round() < self.accepted_round:
+            logger.debug(
+                "too early to insert self-event (%d/%d)",
+                self.hg.store.last_round(),
+                self.accepted_round,
+            )
+            return
+
+        sigs = list(self.self_block_signatures.values())
+        n_txs = len(self.transaction_pool)
+        n_itxs = len(self.internal_transaction_pool)
+
+        new_head = Event.new(
+            self.transaction_pool[:n_txs],
+            self.internal_transaction_pool[:n_itxs],
+            sigs,
+            [self.head, other_head],
+            self.validator.public_key_bytes(),
+            self.seq + 1,
+            timestamp=int(time.time()),
+        )
+
+        # Inserting can add items to the pools via the commit callback, so
+        # only the packaged prefix is dropped (reference: core.go:325-330).
+        self.sign_and_insert_self_event(new_head)
+        self.transaction_pool = self.transaction_pool[n_txs:]
+        self.internal_transaction_pool = self.internal_transaction_pool[n_itxs:]
+        for s in sigs:
+            self.self_block_signatures.pop(s.key(), None)
+
+    def sign_and_insert_self_event(self, event: Event) -> None:
+        """reference: core.go:337-343."""
+        event.sign(self.validator.key)
+        self.insert_event_and_run_consensus(event, set_wire_info=True)
+
+    def insert_event_and_run_consensus(
+        self, event: Event, set_wire_info: bool
+    ) -> None:
+        """reference: core.go:346-355."""
+        self.hg.insert_event_and_run_consensus(event, set_wire_info)
+        if event.creator() == self.validator.public_key_hex():
+            self.head = event.hex()
+            self.seq = event.index()
+
+    def known_events(self) -> Dict[int, int]:
+        return self.hg.store.known_events()
+
+    # -- fast-forward -------------------------------------------------------
+
+    def fast_forward(self, block: Block, frame: Frame) -> None:
+        """Reset the hashgraph from a trusted Block+Frame
+        (reference: core.go:367-402)."""
+        peer_set = PeerSet(frame.peers)
+
+        self.hg.check_block(block, peer_set)
+
+        if block.frame_hash() != frame.hash():
+            raise ValueError("invalid frame hash")
+
+        self.hg.reset(block, frame)
+        self.set_head_and_seq()
+        self.set_peers(peer_set)
+        self.validators = peer_set
+
+    def get_anchor_block_with_frame(self) -> tuple[Block, Frame]:
+        return self.hg.get_anchor_block_with_frame()
+
+    # -- leave --------------------------------------------------------------
+
+    def leave(self, leave_timeout: float, lock=None) -> None:
+        """Politely leave: submit a PEER_REMOVE itx and wait for consensus
+        (reference: core.go:416-479). ``lock`` is the owning node's core
+        lock, held only while mutating the pools — the consensus wait must
+        happen outside it."""
+        p = self.validators.by_id.get(self.validator.id())
+        if p is None or len(self.validators) <= 1 or self.maintenance_mode:
+            return
+
+        itx = InternalTransaction.leave(p)
+        itx.sign(self.validator.key)
+        if lock is not None:
+            with lock:
+                promise = self.add_internal_transaction(itx)
+        else:
+            promise = self.add_internal_transaction(itx)
+
+        try:
+            resp = promise.wait(timeout=leave_timeout)
+        except queue.Empty:
+            raise TimeoutError("timeout waiting for leave request consensus")
+
+        logger.debug("leave accepted at round %d", resp.accepted_round)
+
+        # Wait until consensus reaches the removed round
+        # (reference: core.go:458-478).
+        if len(self.peers) >= 1:
+            deadline = time.monotonic() + leave_timeout
+            while (
+                self.hg.last_consensus_round is None
+                or self.hg.last_consensus_round < self.removed_round
+            ):
+                if time.monotonic() > deadline:
+                    raise TimeoutError("timeout waiting to reach removed round")
+                time.sleep(0.05)
+
+    # -- commit -------------------------------------------------------------
+
+    def commit(self, block: Block) -> None:
+        """The hashgraph's commit callback: push the block to the app, sign
+        it, and process membership receipts (reference: core.go:485-536)."""
+        commit_response = self.proxy_commit_callback(block)
+
+        block.body.state_hash = commit_response.state_hash
+        block.body.internal_transaction_receipts = commit_response.receipts
+
+        # Sign the block if we belong to its validator-set
+        # (reference: core.go:510-522).
+        block_peer_set = self.hg.store.get_peer_set(block.round_received())
+        if self.validator.id() in block_peer_set.by_id:
+            sig = self.sign_block(block)
+            self.self_block_signatures[sig.key()] = sig
+
+        self.hg.set_anchor_block(block)
+
+        self.process_accepted_internal_transactions(
+            block.round_received(), commit_response.receipts
+        )
+
+    def sign_block(self, block: Block):
+        """reference: core.go:539-556."""
+        sig = block.sign(self.validator.key)
+        block.set_signature(sig)
+        self.hg.store.set_block(block)
+        return sig
+
+    def process_accepted_internal_transactions(
+        self, round_received: int, receipts: List[InternalTransactionReceipt]
+    ) -> None:
+        """Apply accepted PEER_ADD/PEER_REMOVE at round_received + 6
+        (reference: core.go:562-650)."""
+        current_peers = self.peers
+        validators = self.validators
+        effective_round = round_received + PEER_SET_EFFECTIVE_DELAY
+
+        changed = False
+        for r in receipts:
+            body = r.internal_transaction.body
+            if not r.accepted:
+                continue
+            if body.type == TransactionType.PEER_ADD:
+                validators = validators.with_new_peer(body.peer)
+                current_peers = current_peers.with_new_peer(body.peer)
+            elif body.type == TransactionType.PEER_REMOVE:
+                validators = validators.with_removed_peer(body.peer)
+                current_peers = current_peers.with_removed_peer(body.peer)
+                if body.peer.id == self.validator.id():
+                    self.removed_round = effective_round
+            else:
+                continue
+            changed = True
+
+        if changed:
+            self.last_peer_change_round = effective_round
+            self.hg.store.set_peer_set(effective_round, validators)
+            self.validators = validators
+            self.set_peers(current_peers)
+            # Force everyone to reach the effective round so joiners can
+            # participate (reference: core.go:639-643).
+            if effective_round > self.target_round:
+                self.target_round = effective_round
+
+        for r in receipts:
+            promise = self.promises.pop(r.internal_transaction.hash_string(), None)
+            if promise is not None:
+                if r.accepted:
+                    promise.respond(True, effective_round, self.validators.peers)
+                else:
+                    promise.respond(False, 0, [])
+
+    # -- diff ---------------------------------------------------------------
+
+    def event_diff(self, other_known: Dict[int, int]) -> List[Event]:
+        """Events we know that the other does not, topologically ordered
+        (reference: core.go:660-703)."""
+        unknown: List[Event] = []
+        my_known = self.known_events()
+        repertoire = self.hg.store.repertoire_by_id()
+        for pid in my_known:
+            ct = other_known.get(pid, -1)
+            peer = repertoire.get(pid)
+            if peer is None:
+                continue
+            for eh in self.hg.store.participant_events(peer.pub_key_hex, ct):
+                unknown.append(self.hg.store.get_event(eh))
+        return sort_topological(unknown)
+
+    def to_wire(self, events: List[Event]) -> List[WireEvent]:
+        return [e.to_wire() for e in events]
+
+    # -- pools --------------------------------------------------------------
+
+    def process_sig_pool(self) -> None:
+        self.hg.process_sig_pool()
+
+    def add_transactions(self, txs: List[bytes]) -> None:
+        self.transaction_pool.extend(txs)
+
+    def add_internal_transaction(self, tx: InternalTransaction) -> JoinPromise:
+        """reference: core.go:747-758."""
+        promise = JoinPromise(tx)
+        self.promises[tx.hash_string()] = promise
+        self.internal_transaction_pool.append(tx)
+        return promise
+
+    # -- getters ------------------------------------------------------------
+
+    def get_head(self) -> Event:
+        return self.hg.store.get_event(self.head)
+
+    def get_event(self, h: str) -> Event:
+        return self.hg.store.get_event(h)
+
+    def get_consensus_events_count(self) -> int:
+        return self.hg.store.consensus_events_count()
+
+    def get_undetermined_events(self) -> List[str]:
+        return self.hg.undetermined_events
+
+    def get_last_block_index(self) -> int:
+        return self.hg.store.last_block_index()
+
+    def get_last_consensus_round_index(self) -> Optional[int]:
+        return self.hg.last_consensus_round
+
+    def get_consensus_transactions_count(self) -> int:
+        return self.hg.consensus_transactions

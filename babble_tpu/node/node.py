@@ -1,0 +1,564 @@
+"""Node: the top-level actor running the gossip state machine.
+
+Reference semantics: src/node/node.go — Init picks the starting state
+(:128-164), Run dispatches on state (:168-199), doBackgroundWork drains
+the transport and submit queues (:341-361), babble() gossips on timer
+ticks (:416-463), gossip = pull + push (:466-615), fastForward (:622-701),
+join (:709-751), suspend (:384-408); RPC handlers in src/node/node_rpc.go.
+
+Threading model: one background worker thread (transport consumer +
+submit queue), one state-machine thread, gossip rounds on the bounded
+routine pool, all hashgraph access serialized by core_lock — mirroring
+the reference's coreLock discipline (node.go:35).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config.config import Config
+from ..hashgraph.errors import is_normal_self_parent_error
+from ..hashgraph.event import WireEvent
+from ..hashgraph.internal_transaction import InternalTransaction
+from ..hashgraph.store import Store
+from ..net.rpc import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    JoinRequest,
+    JoinResponse,
+    RPC,
+    SyncRequest,
+    SyncResponse,
+)
+from ..net.transport import Transport, TransportError
+from ..peers.peer import Peer
+from ..peers.peer_set import PeerSet
+from ..proxy.proxy import AppProxy
+from .control_timer import ControlTimer
+from .core import Core
+from .state import State, StateManager
+from .validator import Validator
+
+logger = logging.getLogger(__name__)
+
+
+class Node(StateManager):
+    """reference: node/node.go:22-75."""
+
+    def __init__(
+        self,
+        conf: Config,
+        validator: Validator,
+        peers: PeerSet,
+        genesis_peers: PeerSet,
+        store: Store,
+        trans: Transport,
+        proxy: AppProxy,
+    ):
+        super().__init__()
+        self.conf = conf
+        self.logger = conf.logger("node")
+        self.core = Core(
+            validator,
+            peers,
+            genesis_peers,
+            store,
+            proxy.commit_block,
+            conf.maintenance_mode,
+        )
+        self.core_lock = threading.Lock()
+        self.trans = trans
+        self.proxy = proxy
+        self.submit_q = proxy.submit_queue()
+        self.control_timer = ControlTimer()
+        self.shutdown_event = threading.Event()
+        self.suspend_event = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.start_time = 0.0
+        self.sync_requests = 0
+        self.sync_errors = 0
+        self.initial_undetermined_events = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self) -> None:
+        """Pick the initial state (reference: node.go:128-164)."""
+        if self.conf.bootstrap:
+            self.core.bootstrap()
+            with self.core_lock:
+                self.core.set_head_and_seq()
+
+        if not self.conf.maintenance_mode:
+            self.trans.listen()
+            if self.core.validator.id() in self.core.peers.by_id:
+                self._set_babbling_or_catching_up_state()
+            else:
+                self._transition(State.JOINING)
+        else:
+            self._transition(State.SUSPENDED)
+
+        self.initial_undetermined_events = len(self.core.get_undetermined_events())
+
+    def run(self, gossip: bool = True) -> None:
+        """Main loop (reference: node.go:168-199)."""
+        if self.conf.maintenance_mode:
+            return
+        self.start_time = time.monotonic()
+        self.control_timer.run(self.conf.heartbeat_timeout)
+        bg = threading.Thread(target=self._do_background_work, daemon=True)
+        bg.start()
+        self._threads.append(bg)
+
+        while True:
+            state = self.get_state()
+            if state == State.BABBLING:
+                self._babble(gossip)
+            elif state == State.CATCHING_UP:
+                self._fast_forward()
+            elif state == State.JOINING:
+                self._join()
+            elif state == State.SUSPENDED:
+                time.sleep(0.2)
+            elif state == State.SHUTDOWN:
+                return
+            else:
+                time.sleep(0.05)
+
+    def run_async(self, gossip: bool = True) -> None:
+        t = threading.Thread(target=self.run, args=(gossip,), daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def leave(self) -> None:
+        """Politely leave the network (reference: node.go:207-224)."""
+        if self.conf.maintenance_mode:
+            return
+        try:
+            self.core.leave(self.conf.join_timeout, lock=self.core_lock)
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        """reference: node.go:228-246."""
+        if self.get_state() != State.SHUTDOWN:
+            self.logger.info("SHUTDOWN")
+            self._transition(State.SHUTDOWN)
+            self.shutdown_event.set()
+            self.control_timer.shutdown()
+            self.wait_routines(timeout=2.0)
+            if self.trans is not None:
+                self.trans.close()
+            self.core.hg.store.close()
+
+    def suspend(self) -> None:
+        """Stop gossiping but keep answering sync requests
+        (reference: node.go:250-262)."""
+        if self.get_state() not in (State.SUSPENDED, State.SHUTDOWN):
+            self.logger.info("SUSPEND")
+            self._transition(State.SUSPENDED)
+            self.suspend_event.set()
+            self.wait_routines(timeout=2.0)
+
+    # -- getters ------------------------------------------------------------
+
+    def get_id(self) -> int:
+        return self.core.validator.id()
+
+    def get_pub_key(self) -> str:
+        return self.core.validator.public_key_hex()
+
+    def get_block(self, index: int):
+        return self.core.hg.store.get_block(index)
+
+    def get_last_block_index(self) -> int:
+        return self.core.get_last_block_index()
+
+    def get_last_consensus_round_index(self) -> int:
+        lcr = self.core.get_last_consensus_round_index()
+        return -1 if lcr is None else lcr
+
+    def get_peers(self) -> List[Peer]:
+        return self.core.peers.peers
+
+    def get_validator_set(self, round: int) -> List[Peer]:
+        return self.core.hg.store.get_peer_set(round).peers
+
+    def get_all_validator_sets(self) -> Dict[int, List[Peer]]:
+        return self.core.hg.store.get_all_peer_sets()
+
+    def get_stats(self) -> Dict[str, str]:
+        """reference: node.go:277-294."""
+        return {
+            "last_consensus_round": str(self.get_last_consensus_round_index()),
+            "last_block_index": str(self.get_last_block_index()),
+            "consensus_events": str(self.core.get_consensus_events_count()),
+            "undetermined_events": str(len(self.core.get_undetermined_events())),
+            "transactions": str(self.core.get_consensus_transactions_count()),
+            "transaction_pool": str(len(self.core.transaction_pool)),
+            "num_peers": str(len(self.core.peer_selector.get_peers())),
+            "last_peer_change": str(self.core.last_peer_change_round),
+            "id": str(self.get_id()),
+            "state": str(self.get_state()),
+            "moniker": self.core.validator.moniker,
+        }
+
+    # -- background ---------------------------------------------------------
+
+    def _do_background_work(self) -> None:
+        """Drain transport RPCs and submitted transactions
+        (reference: node.go:341-361)."""
+        net_q = self.trans.consumer()
+        while not self.shutdown_event.is_set():
+            handled = False
+            try:
+                rpc = net_q.get(timeout=0.01)
+                handled = True
+                self.go_func(lambda r=rpc: (self._process_rpc(r), self._reset_timer()))
+            except queue.Empty:
+                pass
+            try:
+                while True:
+                    tx = self.submit_q.get_nowait()
+                    handled = True
+                    self._add_transaction(tx)
+            except queue.Empty:
+                pass
+            if handled:
+                self._reset_timer()
+
+    def _reset_timer(self) -> None:
+        """reference: node.go:365-379."""
+        if not self.control_timer.is_set:
+            with self.core_lock:
+                busy = self.core.busy()
+            ts = (
+                self.conf.heartbeat_timeout
+                if busy
+                else self.conf.slow_heartbeat_timeout
+            )
+            self.control_timer.reset(ts)
+
+    def _check_suspend(self) -> None:
+        """Auto-suspend on runaway undetermined events or eviction
+        (reference: node.go:384-408)."""
+        new_undetermined = (
+            len(self.core.get_undetermined_events())
+            - self.initial_undetermined_events
+        )
+        too_many = new_undetermined > self.conf.suspend_limit * len(
+            self.core.validators
+        )
+        evicted = (
+            self.core.hg.last_consensus_round is not None
+            and self.core.removed_round > 0
+            and self.core.removed_round > self.core.accepted_round
+            and self.core.hg.last_consensus_round >= self.core.removed_round
+        )
+        if too_many or evicted:
+            self.suspend()
+
+    # -- babbling -----------------------------------------------------------
+
+    def _babble(self, gossip: bool) -> None:
+        """Gossip or monologue on each timer tick (reference: node.go:416-443)."""
+        self.logger.info("BABBLING")
+        self.suspend_event.clear()
+        while True:
+            if self.shutdown_event.is_set() or self.suspend_event.is_set():
+                return
+            if self.get_state() != State.BABBLING:
+                return
+            if self.control_timer.tick.wait(timeout=0.1):
+                self.control_timer.tick.clear()
+                if gossip:
+                    peer = self.core.peer_selector.next()
+                    if peer is not None:
+                        self.go_func(lambda p=peer: self._gossip(p))
+                    else:
+                        self._monologue()
+                self._reset_timer()
+                self._check_suspend()
+
+    def _monologue(self) -> None:
+        """Record events even when alone (reference: node.go:447-463)."""
+        with self.core_lock:
+            if self.core.busy():
+                self.core.add_self_event("")
+                self.core.process_sig_pool()
+
+    def _gossip(self, peer: Peer) -> None:
+        """Pull-push gossip round (reference: node.go:466-501)."""
+        connected = False
+        try:
+            other_known = self._pull(peer)
+            self._push(peer, other_known)
+            connected = True
+            self._log_stats()
+        except TransportError as err:
+            self.logger.debug("gossip transport error: %s", err)
+        except Exception as err:
+            self.logger.warning("gossip error: %s", err)
+        finally:
+            self.core.peer_selector.update_last(peer.id, connected)
+
+    def _pull(self, peer: Peer) -> Dict[int, int]:
+        """SyncRequest leg (reference: node.go:504-538)."""
+        with self.core_lock:
+            known = self.core.known_events()
+        resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
+        with self.core_lock:
+            self._sync(peer.id, resp.events)
+        return resp.known
+
+    def _push(self, peer: Peer, known_events: Dict[int, int]) -> None:
+        """EagerSyncRequest leg (reference: node.go:541-587)."""
+        with self.core_lock:
+            diff = self.core.event_diff(known_events)
+        if not diff:
+            return
+        if len(diff) > self.conf.sync_limit:
+            diff = diff[: self.conf.sync_limit]
+        wire = self.core.to_wire(diff)
+        self._request_eager_sync(peer.net_addr, wire)
+
+    def _sync(self, from_id: int, events: List[WireEvent]) -> None:
+        """Insert events + process the sig pool; callers hold core_lock
+        (reference: node.go:591-615)."""
+        try:
+            self.core.sync(from_id, events)
+        except Exception as err:
+            if not is_normal_self_parent_error(err):
+                raise
+        self.core.process_sig_pool()
+
+    # -- catching up --------------------------------------------------------
+
+    def _fast_forward(self) -> None:
+        """reference: node.go:622-666."""
+        self.logger.info("CATCHING-UP")
+        self.wait_routines(timeout=2.0)
+
+        resp = self._get_best_fast_forward_response()
+        if resp is None:
+            self._transition(State.BABBLING)
+            return
+
+        try:
+            self.proxy.restore(resp.snapshot)
+            with self.core_lock:
+                self.core.fast_forward(resp.block, resp.frame)
+            self.core.process_accepted_internal_transactions(
+                resp.block.round_received(),
+                resp.block.internal_transaction_receipts(),
+            )
+        except Exception as err:
+            self.logger.error("fast-forward failed: %s", err)
+            return
+
+        self._transition(State.BABBLING)
+
+    def _get_best_fast_forward_response(self) -> Optional[FastForwardResponse]:
+        """Poll all peers, keep the highest block (reference: node.go:670-701)."""
+        best: Optional[FastForwardResponse] = None
+        max_block = 0
+        for p in self.core.peer_selector.get_peers().peers:
+            if p.id == self.get_id():
+                continue
+            try:
+                resp = self._request_fast_forward(p.net_addr)
+            except TransportError as err:
+                self.logger.debug("requestFastForward(%s): %s", p.net_addr, err)
+                continue
+            if resp.block is not None and resp.block.index() > max_block:
+                best = resp
+                max_block = resp.block.index()
+        return best
+
+    # -- joining ------------------------------------------------------------
+
+    def _join(self) -> None:
+        """reference: node.go:709-751."""
+        if self.conf.maintenance_mode:
+            return
+        self.logger.info("JOINING")
+        peer = self.core.peer_selector.next()
+        if peer is None:
+            time.sleep(0.2)
+            return
+        try:
+            resp = self._request_join(peer.net_addr)
+        except TransportError as err:
+            self.logger.warning("cannot join via %s: %s", peer.net_addr, err)
+            time.sleep(0.2)
+            return
+
+        if resp.accepted:
+            self.core.accepted_round = resp.accepted_round
+            self.core.removed_round = -1
+            self._set_babbling_or_catching_up_state()
+        else:
+            self.logger.info("join request rejected")
+            self.shutdown()
+
+    # -- client-side RPCs (reference: node_rpc.go:15-74) --------------------
+
+    def _request_sync(
+        self, target: str, known: Dict[int, int], sync_limit: int
+    ) -> SyncResponse:
+        return self.trans.sync(
+            target, SyncRequest(self.get_id(), known, sync_limit)
+        )
+
+    def _request_eager_sync(
+        self, target: str, events: List[WireEvent]
+    ) -> EagerSyncResponse:
+        return self.trans.eager_sync(target, EagerSyncRequest(self.get_id(), events))
+
+    def _request_fast_forward(self, target: str) -> FastForwardResponse:
+        return self.trans.fast_forward(target, FastForwardRequest(self.get_id()))
+
+    def _request_join(self, target: str) -> JoinResponse:
+        join_tx = InternalTransaction.join(
+            Peer(
+                net_addr=self.trans.advertise_addr(),
+                pub_key_hex=self.core.validator.public_key_hex(),
+                moniker=self.core.validator.moniker,
+            )
+        )
+        join_tx.sign(self.core.validator.key)
+        return self.trans.join(target, JoinRequest(join_tx))
+
+    # -- server-side RPCs (reference: node_rpc.go:76-315) -------------------
+
+    def _process_rpc(self, rpc: RPC) -> None:
+        """Gate on state, dispatch by command type
+        (reference: node_rpc.go:76-104)."""
+        state = self.get_state()
+        is_sync = isinstance(rpc.command, SyncRequest)
+        if not (
+            state == State.BABBLING or (state == State.SUSPENDED and is_sync)
+        ):
+            rpc.respond(None, f"not in Babbling state ({state})")
+            return
+
+        cmd = rpc.command
+        if isinstance(cmd, SyncRequest):
+            self._process_sync_request(rpc, cmd)
+        elif isinstance(cmd, EagerSyncRequest):
+            self._process_eager_sync_request(rpc, cmd)
+        elif isinstance(cmd, FastForwardRequest):
+            self._process_fast_forward_request(rpc, cmd)
+        elif isinstance(cmd, JoinRequest):
+            self._process_join_request(rpc, cmd)
+        else:
+            rpc.respond(None, "unexpected command")
+
+    def _process_sync_request(self, rpc: RPC, cmd: SyncRequest) -> None:
+        """reference: node_rpc.go:106-172."""
+        self.sync_requests += 1
+        resp = SyncResponse(from_id=self.get_id())
+        err: Optional[str] = None
+        try:
+            with self.core_lock:
+                diff = self.core.event_diff(cmd.known)
+            limit = min(cmd.sync_limit, self.conf.sync_limit)
+            if len(diff) > limit:
+                diff = diff[:limit]
+            resp.events = self.core.to_wire(diff)
+            with self.core_lock:
+                resp.known = self.core.known_events()
+        except Exception as e:
+            self.sync_errors += 1
+            err = str(e)
+        rpc.respond(resp, err)
+
+    def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
+        """reference: node_rpc.go:180-203."""
+        success = True
+        err: Optional[str] = None
+        try:
+            with self.core_lock:
+                self._sync(cmd.from_id, cmd.events)
+        except Exception as e:
+            success = False
+            err = str(e)
+        rpc.respond(EagerSyncResponse(self.get_id(), success), err)
+
+    def _process_fast_forward_request(
+        self, rpc: RPC, cmd: FastForwardRequest
+    ) -> None:
+        """reference: node_rpc.go:205-247."""
+        resp = FastForwardResponse(from_id=self.get_id())
+        err: Optional[str] = None
+        try:
+            with self.core_lock:
+                block, frame = self.core.get_anchor_block_with_frame()
+            resp.block = block
+            resp.frame = frame
+            resp.snapshot = self.proxy.get_snapshot(block.index())
+        except Exception as e:
+            err = str(e)
+        rpc.respond(resp, err)
+
+    def _process_join_request(self, rpc: RPC, cmd: JoinRequest) -> None:
+        """reference: node_rpc.go:249-315."""
+        err: Optional[str] = None
+        accepted = False
+        accepted_round = 0
+        peers: List[Peer] = []
+
+        itx = cmd.internal_transaction
+        if not itx.verify():
+            err = "unable to verify signature on join request"
+        elif itx.body.peer.pub_key_hex in self.core.peers.by_pub_key:
+            accepted = True
+            lcr = self.core.get_last_consensus_round_index()
+            if lcr is not None:
+                accepted_round = lcr
+            peers = self.core.peers.peers
+        else:
+            with self.core_lock:
+                promise = self.core.add_internal_transaction(itx)
+            try:
+                presp = promise.wait(timeout=self.conf.join_timeout)
+                accepted = presp.accepted
+                accepted_round = presp.accepted_round
+                peers = presp.peers
+            except queue.Empty:
+                err = "timeout waiting for join request to reach consensus"
+
+        rpc.respond(
+            JoinResponse(self.get_id(), accepted, accepted_round, peers), err
+        )
+
+    # -- utils --------------------------------------------------------------
+
+    def _transition(self, state: State) -> None:
+        """reference: node.go:758-765."""
+        self.set_state(state)
+        try:
+            self.proxy.on_state_changed(state)
+        except Exception as err:
+            self.logger.error("OnStateChanged: %s", err)
+
+    def _set_babbling_or_catching_up_state(self) -> None:
+        """reference: node.go:768-780."""
+        if self.conf.enable_fast_sync:
+            self._transition(State.CATCHING_UP)
+        else:
+            self.core.set_head_and_seq()
+            self._transition(State.BABBLING)
+
+    def _add_transaction(self, tx: bytes) -> None:
+        """reference: node.go:784-789."""
+        with self.core_lock:
+            self.core.add_transactions([tx])
+
+    def _log_stats(self) -> None:
+        self.logger.debug("stats: %s", self.get_stats())

@@ -1,0 +1,58 @@
+"""Peer selection for gossip.
+
+Reference semantics: src/node/peer_selector.go:11-103 — pick the next
+gossip partner at random, excluding self and the last-contacted peer, and
+track per-peer connected flags.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional, Protocol
+
+from ..peers.peer import Peer
+from ..peers.peer_set import PeerSet
+
+
+class PeerSelector(Protocol):
+    def get_peers(self) -> PeerSet: ...
+
+    def update_last(self, peer_id: int, connected: bool) -> bool: ...
+
+    def next(self) -> Optional[Peer]: ...
+
+
+class RandomPeerSelector:
+    """reference: peer_selector.go:19-103."""
+
+    def __init__(self, peer_set: PeerSet, self_id: int):
+        self.peers = peer_set
+        self.self_id = self_id
+        self._selectable: Dict[int, Peer] = {
+            p.id: p for p in peer_set.peers if p.id != self_id
+        }
+        self._connected: Dict[int, bool] = {pid: False for pid in self._selectable}
+        self.last: Optional[int] = None
+
+    def get_peers(self) -> PeerSet:
+        return self.peers
+
+    def update_last(self, peer_id: int, connected: bool) -> bool:
+        """Record the outcome of the last gossip; returns True on a new
+        connection (reference: peer_selector.go:62-77)."""
+        self.last = peer_id
+        if peer_id in self._connected:
+            old = self._connected[peer_id]
+            self._connected[peer_id] = connected
+            return connected and not old
+        return False
+
+    def next(self) -> Optional[Peer]:
+        """reference: peer_selector.go:80-103."""
+        ids = list(self._selectable.keys())
+        if not ids:
+            return None
+        if len(ids) == 1:
+            return self._selectable[ids[0]]
+        candidates = [i for i in ids if i != self.last] or ids
+        return self._selectable[random.choice(candidates)]

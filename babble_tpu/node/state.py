@@ -1,0 +1,76 @@
+"""Node state machine primitives.
+
+Reference semantics: src/node/state/state.go:10-101 — six states, an
+atomically-updated current state, and a bounded pool of background
+routines (WGLIMIT=20) that can be waited on.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, List
+
+
+class State(enum.IntEnum):
+    """reference: state/state.go:10-36."""
+
+    BABBLING = 0
+    CATCHING_UP = 1
+    JOINING = 2
+    LEAVING = 3
+    SHUTDOWN = 4
+    SUSPENDED = 5
+
+    def __str__(self) -> str:
+        return {
+            State.BABBLING: "Babbling",
+            State.CATCHING_UP: "CatchingUp",
+            State.JOINING: "Joining",
+            State.LEAVING: "Leaving",
+            State.SHUTDOWN: "Shutdown",
+            State.SUSPENDED: "Suspended",
+        }[self]
+
+
+# Maximum concurrently running background routines
+# (reference: state/state.go:41).
+WGLIMIT = 20
+
+
+class StateManager:
+    """Current state + bounded background-routine pool
+    (reference: state/state.go:62-101)."""
+
+    def __init__(self) -> None:
+        self._state = State.BABBLING
+        self._state_lock = threading.Lock()
+        self._routines_lock = threading.Lock()
+        self._routines: List[threading.Thread] = []
+
+    def get_state(self) -> State:
+        with self._state_lock:
+            return self._state
+
+    def set_state(self, s: State) -> None:
+        with self._state_lock:
+            self._state = s
+
+    def go_func(self, f: Callable[[], None]) -> None:
+        """Run f on a background thread if fewer than WGLIMIT are live
+        (reference: state/state.go:86-97)."""
+        with self._routines_lock:
+            self._routines = [t for t in self._routines if t.is_alive()]
+            if len(self._routines) >= WGLIMIT:
+                return
+            t = threading.Thread(target=f, daemon=True)
+            t.start()
+            self._routines.append(t)
+
+    def wait_routines(self, timeout: float = 10.0) -> None:
+        """Wait for all live background routines
+        (reference: state/state.go:99-101)."""
+        with self._routines_lock:
+            routines = list(self._routines)
+        for t in routines:
+            t.join(timeout=timeout)

@@ -26,6 +26,7 @@ the golden DAGs in tests/test_ops_dag.py.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -387,12 +388,29 @@ def decide_round_received(
 # =============================================================================
 
 
+# Counts traces of _run_jit, so tests can pin the compile-cache property.
+_trace_count = 0
+
+
+@partial(jax.jit, static_argnums=(7, 8))
+def _run_jit(creator, index, sp, op, la, fd, mid, sm, round_bound):
+    global _trace_count
+    _trace_count += 1
+    see = see_matrix(creator, index, la)
+    ss = strongly_see_matrix(la, fd, sm)
+    rounds, wit = compute_rounds(creator, sp, op, ss, sm)
+    lamport = compute_lamport(sp, op)
+    fame = decide_fame(rounds, wit, see, ss, mid, sm, round_bound)
+    rr = decide_round_received(rounds, wit, fame, see, sm, round_bound)
+    return see, ss, rounds, wit, lamport, fame, rr
+
+
 def run_pipeline(snapshot: DagSnapshot) -> Dict[str, np.ndarray]:
     """Run the tensorized pipeline on a snapshot; returns host arrays.
 
     This is the all-at-once (batch) formulation: given the DAG window, it
     computes rounds, witnesses, lamport timestamps, fame, and round-received
-    in one jit-compiled program.
+    in one jit-compiled program, cached per (shape, super-majority, bound).
     """
     sm = snapshot.super_majority
 
@@ -403,17 +421,7 @@ def run_pipeline(snapshot: DagSnapshot) -> Dict[str, np.ndarray]:
     # no-ops; callers with a tighter known bound can pass their own.
     round_bound = snapshot.n_events
 
-    @jax.jit
-    def _run(creator, index, sp, op, la, fd, mid):
-        see = see_matrix(creator, index, la)
-        ss = strongly_see_matrix(la, fd, sm)
-        rounds, wit = compute_rounds(creator, sp, op, ss, sm)
-        lamport = compute_lamport(sp, op)
-        fame = decide_fame(rounds, wit, see, ss, mid, sm, round_bound)
-        rr = decide_round_received(rounds, wit, fame, see, sm, round_bound)
-        return see, ss, rounds, wit, lamport, fame, rr
-
-    see, ss, rounds, wit, lamport, fame, rr = _run(
+    see, ss, rounds, wit, lamport, fame, rr = _run_jit(
         jnp.asarray(snapshot.creator),
         jnp.asarray(snapshot.index),
         jnp.asarray(snapshot.self_parent),
@@ -421,6 +429,8 @@ def run_pipeline(snapshot: DagSnapshot) -> Dict[str, np.ndarray]:
         jnp.asarray(snapshot.last_ancestors),
         jnp.asarray(snapshot.first_descendants),
         jnp.asarray(snapshot.middle_bit),
+        sm,
+        round_bound,
     )
     return {
         "see": np.asarray(see),

@@ -89,8 +89,11 @@ def test_pipeline_matches_oracle(graph):
 
 
 def test_jit_compiles_once():
-    """The pipeline is one jitted XLA program over static shapes."""
+    """Repeat runs on the same-shaped snapshot hit the compile cache: the
+    jitted program traces at most once more, and outputs are identical."""
     _, _, snapshot = _oracle_and_snapshot(BUILDERS["basic"])
     out1 = dag_ops.run_pipeline(snapshot)
+    traces_after_first = dag_ops._trace_count
     out2 = dag_ops.run_pipeline(snapshot)
+    assert dag_ops._trace_count == traces_after_first, "pipeline retraced"
     np.testing.assert_array_equal(out1["rounds"], out2["rounds"])
