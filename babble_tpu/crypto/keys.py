@@ -30,13 +30,27 @@ _FNV_PRIME = 0x01000193
 try:  # OpenSSL fast path
     from cryptography.hazmat.primitives.asymmetric import ec as _ec
     from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature as _decode_dss,
         encode_dss_signature as _encode_dss,
     )
     from cryptography.hazmat.primitives import hashes as _hashes
     from cryptography.hazmat.primitives.asymmetric.utils import Prehashed as _Prehashed
     from cryptography.exceptions import InvalidSignature as _InvalidSignature
+    from functools import lru_cache as _lru_cache
 
     _HAVE_OPENSSL = True
+
+    @_lru_cache(maxsize=1024)
+    def _openssl_pub(x: int, y: int):
+        return _ec.EllipticCurvePublicNumbers(x, y, _ec.SECP256K1()).public_key()
+
+    @_lru_cache(maxsize=64)
+    def _openssl_priv(d: int):
+        pub_x, pub_y = curve.pubkey_from_scalar(d)
+        return _ec.EllipticCurvePrivateNumbers(
+            d, _ec.EllipticCurvePublicNumbers(pub_x, pub_y, _ec.SECP256K1())
+        ).private_key()
+
 except Exception:  # pragma: no cover - cryptography is in the base image
     _HAVE_OPENSSL = False
 
@@ -118,9 +132,7 @@ class PublicKey:
     def verify_rs(self, msg_hash: bytes, r: int, s: int) -> bool:
         if _HAVE_OPENSSL:
             try:
-                pub = _ec.EllipticCurvePublicNumbers(
-                    self.x, self.y, _ec.SECP256K1()
-                ).public_key()
+                pub = _openssl_pub(self.x, self.y)
                 pub.verify(
                     _encode_dss(r, s), msg_hash, _ec.ECDSA(_Prehashed(_hashes.SHA256()))
                 )
@@ -156,6 +168,14 @@ class PrivateKey:
         return encode_signature(r, s)
 
     def sign_rs(self, msg_hash: bytes) -> Tuple[int, int]:
+        if _HAVE_OPENSSL:
+            try:
+                der = _openssl_priv(self.d).sign(
+                    msg_hash, _ec.ECDSA(_Prehashed(_hashes.SHA256()))
+                )
+                return _decode_dss(der)
+            except Exception:
+                pass  # fall through to pure python on backend errors
         return curve.sign(self.d, msg_hash)
 
     def bytes(self) -> bytes:

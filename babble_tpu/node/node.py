@@ -84,6 +84,10 @@ class Node(StateManager):
         self.sync_requests = 0
         self.sync_errors = 0
         self.initial_undetermined_events = 0
+        # Cap overlapping gossip rounds: unbounded overlap just piles
+        # threads onto core_lock under the GIL (the Go reference relies on
+        # cheap goroutines; here 2 in flight keeps the pipeline full).
+        self._gossip_slots = threading.Semaphore(2)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -279,11 +283,22 @@ class Node(StateManager):
                 if gossip:
                     peer = self.core.peer_selector.next()
                     if peer is not None:
-                        self.go_func(lambda p=peer: self._gossip(p))
+                        if self._gossip_slots.acquire(blocking=False):
+                            started = self.go_func(
+                                lambda p=peer: self._gossip_with_slot(p)
+                            )
+                            if not started:
+                                self._gossip_slots.release()
                     else:
                         self._monologue()
                 self._reset_timer()
                 self._check_suspend()
+
+    def _gossip_with_slot(self, peer: Peer) -> None:
+        try:
+            self._gossip(peer)
+        finally:
+            self._gossip_slots.release()
 
     def _monologue(self) -> None:
         """Record events even when alone (reference: node.go:447-463)."""

@@ -47,6 +47,7 @@ class StateManager:
         self._state_lock = threading.Lock()
         self._routines_lock = threading.Lock()
         self._routines: List[threading.Thread] = []
+        self._live = 0
 
     def get_state(self) -> State:
         with self._state_lock:
@@ -56,21 +57,40 @@ class StateManager:
         with self._state_lock:
             self._state = s
 
-    def go_func(self, f: Callable[[], None]) -> None:
-        """Run f on a background thread if fewer than WGLIMIT are live
-        (reference: state/state.go:86-97)."""
+    def go_func(self, f: Callable[[], None]) -> bool:
+        """Run f on a background thread if fewer than WGLIMIT are live;
+        returns False when the task was declined at the cap
+        (reference: state/state.go:86-97; live count mirrors its wgCount
+        atomic rather than scanning threads)."""
+
+        def wrapped() -> None:
+            try:
+                f()
+            finally:
+                with self._routines_lock:
+                    self._live -= 1
+
         with self._routines_lock:
-            self._routines = [t for t in self._routines if t.is_alive()]
+            if self._live >= WGLIMIT:
+                return False
+            self._live += 1
             if len(self._routines) >= WGLIMIT:
-                return
-            t = threading.Thread(target=f, daemon=True)
+                self._routines = [t for t in self._routines if t.is_alive()]
+            t = threading.Thread(target=wrapped, daemon=True)
             t.start()
             self._routines.append(t)
+        return True
 
     def wait_routines(self, timeout: float = 10.0) -> None:
-        """Wait for all live background routines
+        """Wait up to ``timeout`` total for live background routines
         (reference: state/state.go:99-101)."""
+        import time
+
+        deadline = time.monotonic() + timeout
         with self._routines_lock:
             routines = list(self._routines)
         for t in routines:
-            t.join(timeout=timeout)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            t.join(timeout=remaining)

@@ -1,0 +1,21 @@
+"""Multi-chip parallelism: device meshes, sharded consensus kernels, and
+explicit-collective reductions.
+
+This is the framework's ICI data plane (SURVEY.md §2.3 "TPU-native
+equivalent", §2.5): the gossip transport stays the DCN control plane while
+per-chip batch work — DAG windows and vote reductions — is sharded over a
+``jax.sharding.Mesh`` and reduced with XLA collectives.
+"""
+
+from .mesh import consensus_mesh, shard_batched_snapshot
+from .pipeline import batched_pipeline, sharded_batched_pipeline
+from .collectives import sharded_vote_counts, sharded_strongly_see
+
+__all__ = [
+    "consensus_mesh",
+    "shard_batched_snapshot",
+    "batched_pipeline",
+    "sharded_batched_pipeline",
+    "sharded_vote_counts",
+    "sharded_strongly_see",
+]

@@ -1,0 +1,64 @@
+"""Explicit-collective consensus kernels via shard_map.
+
+Where ``pipeline.sharded_batched_pipeline`` lets GSPMD infer collectives,
+these kernels spell them out: event rows live on different chips and
+super-majority reductions ride ICI as ``psum``/``all_gather``. They are the
+building blocks for streaming consensus where each chip owns a slice of
+the undetermined-event window (ring/CP analogue, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+
+def sharded_strongly_see(mesh: Mesh, super_majority: int):
+    """stronglySee with x-rows sharded over the full mesh.
+
+    la is sharded on rows; fd is all-gathered (each chip needs every
+    candidate y to compare against its local x rows). Returns a function
+    (la [E, P] sharded, fd [E, P] sharded) -> ss [E, E] row-sharded.
+    """
+    axes = ("dp", "sp")
+
+    def kernel(la_local, fd_local):
+        fd_full = lax.all_gather(fd_local, axes, axis=0, tiled=True)
+        ge = la_local[:, None, :] >= fd_full[None, :, :]  # [e_loc, E, P]
+        counts = jnp.sum(ge, axis=-1, dtype=jnp.int32)
+        return counts >= super_majority
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None)),
+        out_specs=P(axes, None),
+    )
+
+
+def sharded_vote_counts(mesh: Mesh):
+    """Super-majority vote tally with voters sharded across chips.
+
+    votes [W, W'] bool (voter w says yay about candidate w') with voter
+    rows sharded; eligible [W] bool marks voters that strongly-see the
+    candidate's round. Yay counts are psum-reduced over the mesh — the
+    DecideFame tally (oracle: hashgraph.go:930-960) as an ICI collective.
+    """
+    axes = ("dp", "sp")
+
+    def kernel(votes_local, eligible_local):
+        local = jnp.sum(
+            votes_local & eligible_local[:, None], axis=0, dtype=jnp.int32
+        )
+        return lax.psum(local, axes)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes)),
+        out_specs=P(None),
+    )

@@ -1,0 +1,60 @@
+"""Device mesh construction and sharding placement for consensus tensors.
+
+Mesh axes:
+- ``dp`` (data parallel): independent DAG windows / signature batches.
+- ``sp`` (sequence parallel): the event dimension within one window — the
+  analogue of context parallelism for the undetermined-event window
+  (SURVEY.md §5 "long-context" mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def consensus_mesh(
+    n_devices: Optional[int] = None, dp: Optional[int] = None
+) -> Mesh:
+    """Build a (dp, sp) mesh over the first n_devices devices.
+
+    ``dp`` defaults to the largest power-of-two ≤ sqrt(n); the rest of the
+    devices go to the ``sp`` axis.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if dp is None:
+        dp = 1
+        while dp * 2 <= int(np.sqrt(n_devices)) and n_devices % (dp * 2) == 0:
+            dp *= 2
+    if n_devices % dp != 0:
+        raise ValueError(f"dp={dp} does not divide n_devices={n_devices}")
+    sp = n_devices // dp
+    mesh_devices = np.array(devices).reshape(dp, sp)
+    return Mesh(mesh_devices, axis_names=("dp", "sp"))
+
+
+def shard_batched_snapshot(mesh: Mesh, arrays: Tuple):
+    """Place a batch of snapshot tensors on the mesh: batch dim over ``dp``,
+    event dim over ``sp``, peer dim replicated.
+
+    ``arrays`` = (creator, index, sp_idx, op_idx, la, fd, mid), each with a
+    leading [B, E, ...] layout.
+    """
+    creator, index, sp_idx, op_idx, la, fd, mid = arrays
+    s2 = NamedSharding(mesh, P("dp", "sp"))
+    s3 = NamedSharding(mesh, P("dp", "sp", None))
+    return (
+        jax.device_put(creator, s2),
+        jax.device_put(index, s2),
+        jax.device_put(sp_idx, s2),
+        jax.device_put(op_idx, s2),
+        jax.device_put(la, s3),
+        jax.device_put(fd, s3),
+        jax.device_put(mid, s2),
+    )

@@ -1,22 +1,25 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding
-(pjit/shard_map over a Mesh) is exercised without TPU hardware. Must run
-before anything imports jax.
+(pjit/shard_map over a Mesh) is exercised without TPU hardware.
+
+The axon sitecustomize imports jax at interpreter startup (before
+conftest), so env-var-only forcing is too late; instead we set XLA_FLAGS
+(read lazily at first backend initialization) and switch platforms with
+jax.config.update before any computation runs.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# The axon sitecustomize force-registers the TPU backend whenever
-# PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS — clear it so the
-# virtual CPU mesh wins under pytest.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -44,7 +44,7 @@ BUILDERS = {
 @pytest.mark.parametrize("graph", list(BUILDERS))
 def test_pipeline_matches_oracle(graph):
     h, index, snapshot = _oracle_and_snapshot(BUILDERS[graph])
-    out = dag_ops.run_pipeline(snapshot)
+    out = dag_ops.run_pipeline(snapshot, return_matrices=True)
     hashes = snapshot.hashes
     E = len(hashes)
     peer_set = h.store.get_peer_set(0)
