@@ -172,6 +172,7 @@ class Event:
         "_creator",
         "_hash",
         "_hex",
+        "_sig_ok",
     )
 
     def __init__(self, body: EventBody, signature: str = ""):
@@ -186,6 +187,7 @@ class Event:
         self._creator: str = ""
         self._hash: bytes = b""
         self._hex: str = ""
+        self._sig_ok: Optional[bool] = None
 
     @staticmethod
     def new(
@@ -261,6 +263,7 @@ class Event:
         self._hash = b""
         self._hex = ""
         self._creator = ""
+        self._sig_ok = None
 
     # -- signatures --------------------------------------------------------
 
@@ -270,7 +273,13 @@ class Event:
 
     def verify(self) -> bool:
         """Verify the creator's signature AND every internal transaction's
-        signature (reference: event.go:219-247)."""
+        signature (reference: event.go:219-247).
+
+        If the event was prevalidated through the accelerator batch
+        verifier (babble_tpu.ops.verify.prevalidate_events), the cached
+        verdict is returned without re-doing host-side ECDSA."""
+        if self._sig_ok is not None:
+            return self._sig_ok
         for itx in self.body.internal_transactions:
             if not itx.verify():
                 return False
@@ -279,6 +288,10 @@ class Event:
         except Exception:
             return False
         return pub.verify(self.hash(), self.signature)
+
+    def prevalidate(self, ok: bool) -> None:
+        """Cache a signature verdict computed out-of-band (batch path)."""
+        self._sig_ok = bool(ok)
 
     # -- consensus annotations --------------------------------------------
 

@@ -858,11 +858,27 @@ class Hashgraph:
     # Wire conversion / block checks
     # =========================================================================
 
-    def read_wire_info(self, wevent: WireEvent) -> Event:
+    def read_wire_info(self, wevent: WireEvent, overlay=None) -> Event:
         """WireEvent → Event: resolve (creatorID, index) pairs back to
-        parent hashes via the participant indexes (reference: hashgraph.go:1540-1595)."""
+        parent hashes via the participant indexes (reference: hashgraph.go:1540-1595).
+
+        ``overlay`` is an optional {(pub_key_hex, index): event_hex} map of
+        events decoded earlier in the same sync batch but not yet inserted —
+        it lets the accelerator path decode a whole batch ahead of insertion
+        for batched signature verification without changing the sequential
+        semantics (parents still must be in the store by insert time)."""
         self_parent = ""
         other_parent = ""
+
+        def resolve(pub_hex: str, idx: int) -> str:
+            try:
+                return self.store.participant_event(pub_hex, idx)
+            except Exception:
+                if overlay is not None:
+                    h = overlay.get((pub_hex, idx))
+                    if h is not None:
+                        return h
+                raise
 
         creator = self.store.repertoire_by_id().get(wevent.body.creator_id)
         if creator is None:
@@ -870,7 +886,7 @@ class Hashgraph:
         creator_bytes = creator.pub_key_bytes()
 
         if wevent.body.self_parent_index >= 0:
-            self_parent = self.store.participant_event(
+            self_parent = resolve(
                 creator.pub_key_hex, wevent.body.self_parent_index
             )
 
@@ -882,7 +898,7 @@ class Hashgraph:
                 raise ValueError(
                     f"participant {wevent.body.other_parent_creator_id} not found"
                 )
-            other_parent = self.store.participant_event(
+            other_parent = resolve(
                 op_creator.pub_key_hex, wevent.body.other_parent_index
             )
 

@@ -48,7 +48,11 @@ class Core:
         store: Store,
         proxy_commit_callback: Callable[[Block], object],
         maintenance_mode: bool = False,
+        accelerated_verify: bool = False,
     ):
+        # Gate the TPU batch-verify path behind a flag (the reference's
+        # north-star `--accelerator` switch); jax is only imported when on.
+        self.accelerated_verify = accelerated_verify
         self.validator = validator
         self.genesis_peers = genesis_peers
         self.validators = genesis_peers
@@ -125,22 +129,54 @@ class Core:
         peer's head, and record a new self-event when busy
         (reference: core.go:210-289)."""
         other_head: Optional[Event] = None
-        for we in unknown_events:
-            ev = self.hg.read_wire_info(we)
-            try:
-                self.insert_event_and_run_consensus(ev, set_wire_info=False)
-            except Exception as err:
-                if is_normal_self_parent_error(err):
-                    # Benign concurrent-duplicate-insert race.
-                    continue
-                raise
+        pos = 0
+        n = len(unknown_events)
+        while pos < n:
+            # Decode the longest possible prefix ahead of insertion so its
+            # signatures can be verified in one accelerator batch. A decode
+            # stall (parent/creator only resolvable after inserting earlier
+            # events, e.g. a mid-batch membership change) cuts the chunk;
+            # the loop resumes after those inserts land — identical
+            # semantics to the reference's sequential decode+insert
+            # (core.go:210-289), just batched where the DAG allows.
+            decoded: List[Event] = []
+            overlay: Dict[tuple, str] = {}
+            j = pos
+            if self.accelerated_verify:
+                while j < n:
+                    try:
+                        ev = self.hg.read_wire_info(unknown_events[j], overlay)
+                    except Exception:
+                        break
+                    overlay[(ev.creator(), ev.index())] = ev.hex()
+                    decoded.append(ev)
+                    j += 1
+                if decoded:
+                    from babble_tpu.ops.verify import prevalidate_events
 
-            if we.body.creator_id == from_id:
-                other_head = ev
+                    prevalidate_events(decoded)
+            if j == pos:
+                # Sequential path (accelerator off, or chunk stalled at the
+                # first event — let read_wire_info raise its real error).
+                decoded = [self.hg.read_wire_info(unknown_events[pos])]
+                j = pos + 1
 
-            stale = self.heads.get(we.body.creator_id)
-            if stale is not None and we.body.index > stale.index():
-                del self.heads[we.body.creator_id]
+            for we, ev in zip(unknown_events[pos:j], decoded):
+                try:
+                    self.insert_event_and_run_consensus(ev, set_wire_info=False)
+                except Exception as err:
+                    if is_normal_self_parent_error(err):
+                        # Benign concurrent-duplicate-insert race.
+                        continue
+                    raise
+
+                if we.body.creator_id == from_id:
+                    other_head = ev
+
+                stale = self.heads.get(we.body.creator_id)
+                if stale is not None and we.body.index > stale.index():
+                    del self.heads[we.body.creator_id]
+            pos = j
 
         # Do not overwrite a non-empty head with an empty one
         # (reference: core.go:246-252).

@@ -71,6 +71,7 @@ class Node(StateManager):
             store,
             proxy.commit_block,
             conf.maintenance_mode,
+            accelerated_verify=conf.accelerator,
         )
         self.core_lock = threading.Lock()
         self.trans = trans
@@ -93,6 +94,12 @@ class Node(StateManager):
 
     def init(self) -> None:
         """Pick the initial state (reference: node.go:128-164)."""
+        if self.conf.accelerator:
+            # Compile the batch-verify kernel before gossip starts so the
+            # first sync doesn't stall behind a ~15 s XLA compile.
+            from babble_tpu.ops.verify import warmup
+
+            warmup()
         if self.conf.bootstrap:
             self.core.bootstrap()
             with self.core_lock:

@@ -77,7 +77,13 @@ class StateManager:
             if len(self._routines) >= WGLIMIT:
                 self._routines = [t for t in self._routines if t.is_alive()]
             t = threading.Thread(target=wrapped, daemon=True)
-            t.start()
+            try:
+                t.start()
+            except Exception:
+                # wrapped() never ran, so undo its accounting here or the
+                # counter saturates and declines work forever.
+                self._live -= 1
+                return False
             self._routines.append(t)
         return True
 

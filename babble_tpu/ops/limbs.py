@@ -1,0 +1,249 @@
+"""256-bit modular field arithmetic for secp256k1 in 16x16-bit limbs (JAX).
+
+TPU has no native 64-bit integer multiply, so big-int math is decomposed
+into 16-bit limbs held in uint32 lanes: a 16x16-bit product fits exactly in
+32 bits, and a 32-column schoolbook accumulation of 16-bit half-products
+stays under 2^21 per column, so no intermediate ever overflows uint32.
+Everything here is elementwise over a leading batch dimension and is
+designed to be `jax.vmap`/`pjit`-sharded over signature batches.
+
+Field: F_p with p = 2^256 - 2^32 - 977 (secp256k1). The special form makes
+reduction a multiply-by-tiny-constant fold: 2^256 === 2^32 + 977 (mod p).
+
+This is the arithmetic layer under babble_tpu/ops/verify.py, the batched
+replacement for per-event signature verification in the reference's insert
+path (/root/reference/src/hashgraph/hashgraph.go:672-687,
+/root/reference/src/crypto/keys/signature.go:20). The portable oracle is
+babble_tpu/crypto/secp256k1.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NLIMB = 16  # 16 limbs x 16 bits = 256 bits
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+# secp256k1 field prime p = 2^256 - C where C = 2^32 + 977
+P_INT = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N_INT = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+C_INT = (1 << 256) - P_INT  # 2^32 + 977
+
+
+def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
+    """Little-endian 16-bit limb decomposition as uint32 numpy array."""
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)], dtype=np.uint32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    out = 0
+    arr = np.asarray(limbs, dtype=np.uint64)
+    for i, v in enumerate(arr):
+        out |= int(v) << (LIMB_BITS * i)
+    return out
+
+
+def ints_to_limbs(xs, n: int = NLIMB) -> np.ndarray:
+    """[B] python ints -> [B, n] uint32 limbs."""
+    return np.stack([int_to_limbs(x, n) for x in xs], axis=0)
+
+
+P_LIMBS = int_to_limbs(P_INT)
+N_LIMBS = int_to_limbs(N_INT)
+C_LIMBS = int_to_limbs(C_INT)  # [977, 0, 1, 0, ...]
+
+# Static index map for schoolbook column accumulation: column k collects
+# lo(a_i*b_j) at i+j == k and hi(a_i*b_j) at i+j == k-1.
+_I, _J = np.meshgrid(np.arange(NLIMB), np.arange(NLIMB), indexing="ij")
+_COL_LO = (_I + _J).reshape(-1)  # [256] in 0..30
+_COL_HI = (_I + _J + 1).reshape(-1)  # [256] in 1..31
+
+
+def _carry_propagate(cols: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """Sequential carry chain over columns -> n_out clean 16-bit limbs.
+
+    cols: [..., n_cols] uint32 with values < 2^26. Returns [..., n_out]
+    limbs plus nothing — callers must size n_out so the final carry is 0
+    (guaranteed by the bound analysis at each call site).
+    """
+    n_cols = cols.shape[-1]
+    if n_cols < n_out:
+        pad = [(0, 0)] * (cols.ndim - 1) + [(0, n_out - n_cols)]
+        cols = jnp.pad(cols, pad)
+        n_cols = n_out
+
+    def step(carry, col):
+        v = col + carry
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    carry0 = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
+    # scan over the limb axis (moved to front)
+    _, limbs = jax.lax.scan(step, carry0, jnp.moveaxis(cols, -1, 0))
+    return jnp.moveaxis(limbs, 0, -1)[..., :n_out]
+
+
+def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[..., 16] x [..., 16] -> [..., 32] full 512-bit product limbs."""
+    prod = a[..., :, None] * b[..., None, :]  # [..., 16, 16] each < 2^32
+    lo = prod & LIMB_MASK
+    hi = prod >> LIMB_BITS
+    flat_lo = lo.reshape(*lo.shape[:-2], NLIMB * NLIMB)
+    flat_hi = hi.reshape(*hi.shape[:-2], NLIMB * NLIMB)
+    cols_lo = jax.ops.segment_sum(
+        jnp.moveaxis(flat_lo, -1, 0), _COL_LO, num_segments=32
+    )
+    cols_hi = jax.ops.segment_sum(
+        jnp.moveaxis(flat_hi, -1, 0), _COL_HI, num_segments=32
+    )
+    cols = jnp.moveaxis(cols_lo + cols_hi, 0, -1)  # [..., 32] < 2^21 each
+    return _carry_propagate(cols, 32)
+
+
+def _fold_once(limbs: jnp.ndarray, n_in: int, n_hi: int) -> jnp.ndarray:
+    """Fold limbs above 256 bits: z = H*2^256 + L === L + H*C (mod p).
+
+    limbs: [..., n_in]; H has n_hi limbs. Returns [..., 17+] columns
+    carried into clean limbs sized to hold L + H*C exactly.
+    """
+    L = limbs[..., :NLIMB]
+    H = limbs[..., NLIMB : NLIMB + n_hi]
+    # H*C where C has 3 limbs [977, 0, 1]: H*977 + H<<32
+    hc_cols = jnp.zeros(
+        (*limbs.shape[:-1], NLIMB + n_hi + 3), dtype=jnp.uint32
+    )
+    h977 = H * np.uint32(977)  # < 2^26
+    hc_cols = hc_cols.at[..., :n_hi].add(h977 & LIMB_MASK)
+    hc_cols = hc_cols.at[..., 1 : n_hi + 1].add(h977 >> LIMB_BITS)
+    hc_cols = hc_cols.at[..., 2 : n_hi + 2].add(H)  # << 32 = 2 limbs
+    hc_cols = hc_cols.at[..., :NLIMB].add(L)
+    n_out = max(NLIMB + 1, n_hi + 3)
+    return _carry_propagate(hc_cols, n_out)
+
+
+def _geq(a: jnp.ndarray, b: np.ndarray) -> jnp.ndarray:
+    """a >= b for clean limb arrays (b a constant [16] array)."""
+    bb = jnp.asarray(b, dtype=jnp.uint32)
+    gt = a > bb
+    lt = a < bb
+    # most-significant difference decides; scan from high limb down
+    def step(state, pair):
+        decided, result = state
+        g, l = pair
+        result = jnp.where(~decided & g, True, result)
+        result = jnp.where(~decided & l, False, result)
+        decided = decided | g | l
+        return (decided, result), None
+
+    init = (
+        jnp.zeros(a.shape[:-1], dtype=bool),
+        jnp.ones(a.shape[:-1], dtype=bool),  # equal => geq True
+    )
+    pairs = (
+        jnp.moveaxis(gt, -1, 0)[::-1],
+        jnp.moveaxis(lt, -1, 0)[::-1],
+    )
+    (decided, result), _ = jax.lax.scan(step, init, pairs)
+    return result
+
+
+def _sub_const(a: jnp.ndarray, b: np.ndarray) -> jnp.ndarray:
+    """a - b (mod 2^256) for clean limbs, b constant, assuming a >= b
+    where selected; borrow chain in uint32."""
+    bb = jnp.asarray(b, dtype=jnp.uint32)
+
+    def step(borrow, pair):
+        av, bv = pair
+        v = av + (LIMB_MASK + 1) - bv - borrow
+        return 1 - (v >> LIMB_BITS), v & LIMB_MASK
+
+    borrow0 = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    pairs = (
+        jnp.moveaxis(a, -1, 0),
+        jnp.moveaxis(jnp.broadcast_to(bb, a.shape), -1, 0),
+    )
+    _, limbs = jax.lax.scan(step, borrow0, pairs)
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def cond_sub_p(a: jnp.ndarray) -> jnp.ndarray:
+    """a mod p for a < 2p: subtract p when a >= p."""
+    ge = _geq(a, P_LIMBS)
+    sub = _sub_const(a, P_LIMBS)
+    return jnp.where(ge[..., None], sub, a)
+
+
+def reduce_p(wide: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] 512-bit product -> [..., 16] canonical mod-p residue."""
+    # fold 1: H up to 16 limbs -> result <= 2^256 + 2^289ish -> 19 limbs
+    f1 = _fold_once(wide, 32, 16)  # [..., 19]
+    # fold 2: H up to 3 limbs -> <= 2^256 + 2^81 -> 17 limbs
+    f2 = _fold_once(f1, f1.shape[-1], max(1, f1.shape[-1] - NLIMB))
+    # fold 3: H at most 1 limb, tiny -> < 2^256 + 2^49
+    f3 = _fold_once(f2, f2.shape[-1], max(1, f2.shape[-1] - NLIMB))
+    r = f3[..., :NLIMB]
+    # at most 2 conditional subtractions of p remain
+    r = cond_sub_p(r)
+    r = cond_sub_p(r)
+    return r
+
+
+def mul_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return reduce_p(mul_wide(a, b))
+
+
+def sqr_mod_p(a: jnp.ndarray) -> jnp.ndarray:
+    return mul_mod_p(a, a)
+
+
+def add_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    cols = a + b  # < 2^17 per column
+    s = _carry_propagate(cols, NLIMB + 1)
+    # s < 2p < 2^257; if bit 256 set or s >= p, subtract p
+    top = s[..., NLIMB]
+    r = s[..., :NLIMB]
+    ge = _geq(r, P_LIMBS) | (top > 0)
+    # when top is set, r + 2^256 - p = r + C
+    sub = _sub_const(r, P_LIMBS)
+    with_top = _carry_propagate(
+        r + jnp.asarray(C_LIMBS, dtype=jnp.uint32), NLIMB
+    )
+    out = jnp.where((top > 0)[..., None], with_top, jnp.where(ge[..., None], sub, r))
+    return out
+
+
+def sub_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod p for canonical residues: a + (p - b) then reduce."""
+    pp = jnp.asarray(P_LIMBS, dtype=jnp.uint32)
+
+    def step(borrow, pair):
+        pv, bv = pair
+        v = pv + (LIMB_MASK + 1) - bv - borrow
+        return 1 - (v >> LIMB_BITS), v & LIMB_MASK
+
+    borrow0 = jnp.zeros(b.shape[:-1], dtype=jnp.uint32)
+    pairs = (
+        jnp.moveaxis(jnp.broadcast_to(pp, b.shape), -1, 0),
+        jnp.moveaxis(b, -1, 0),
+    )
+    _, pb = jax.lax.scan(step, borrow0, pairs)
+    p_minus_b = jnp.moveaxis(pb, 0, -1)
+    return add_mod_p(a, p_minus_b)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limb-wise select: cond [...] bool, a/b [..., 16]."""
+    return jnp.where(cond[..., None], a, b)

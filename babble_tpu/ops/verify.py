@@ -1,0 +1,351 @@
+"""Batched secp256k1 ECDSA verification on the accelerator (JAX).
+
+Replaces the reference's per-event host verification
+(/root/reference/src/hashgraph/hashgraph.go:672-687 ->
+/root/reference/src/crypto/keys/signature.go:20) with a batch kernel.
+
+Hybrid split — the right one for TPU:
+- HOST (cheap, inherently sequential, ~us per signature): range checks on
+  (r, s), on-curve check of the pubkey, e = H(m) truncation, w = s^-1 mod n,
+  u1 = e*w, u2 = r*w, and the tiny per-pubkey precompute G+Q.
+- DEVICE (the FLOPs): R = u1*G + u2*Q by interleaved Shamir double-and-add
+  in Jacobian coordinates — 256 doublings + <=256 mixed additions of
+  16x16-bit limb field ops, `vmap`-batched over signatures and shardable
+  over a device mesh. No modular inversion on device: the affine check
+  x(R) mod n == r is done projectively as X == (r or r+n) * Z^2 (valid
+  because r < n and x < p < 2n).
+
+Degenerate cases (point doubling inside an add, the point at infinity,
+Q == -G making the G+Q table entry infinite) are all handled with limb
+selects so the kernel is branch-free and fully jittable.
+
+Differential oracle: babble_tpu/crypto/secp256k1.py (pure Python).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from babble_tpu.crypto import secp256k1 as ref
+from babble_tpu.ops import limbs as fl
+from babble_tpu.ops.limbs import (
+    NLIMB,
+    add_mod_p,
+    eq,
+    int_to_limbs,
+    ints_to_limbs,
+    is_zero,
+    mul_mod_p,
+    select,
+    sqr_mod_p,
+    sub_mod_p,
+)
+
+# ---------------------------------------------------------------------------
+# Jacobian point ops. A point is (X, Y, Z) limb arrays; Z == 0 <=> infinity.
+# Curve: y^2 = x^3 + 7 (a = 0), so the a-term vanishes in doubling.
+# ---------------------------------------------------------------------------
+
+
+def _double(X, Y, Z):
+    """dbl-2009-l formulas for a=0; infinity (Z=0) maps to Z3=0."""
+    A = sqr_mod_p(X)
+    B = sqr_mod_p(Y)
+    Cc = sqr_mod_p(B)
+    t = sqr_mod_p(add_mod_p(X, B))
+    D = sub_mod_p(sub_mod_p(t, A), Cc)
+    D = add_mod_p(D, D)  # 2*((X+B)^2 - A - C)
+    E = add_mod_p(add_mod_p(A, A), A)  # 3*A
+    F = sqr_mod_p(E)
+    X3 = sub_mod_p(F, add_mod_p(D, D))
+    eightC = add_mod_p(add_mod_p(Cc, Cc), add_mod_p(Cc, Cc))
+    eightC = add_mod_p(eightC, eightC)
+    Y3 = sub_mod_p(mul_mod_p(E, sub_mod_p(D, X3)), eightC)
+    YZ = mul_mod_p(Y, Z)
+    Z3 = add_mod_p(YZ, YZ)
+    return X3, Y3, Z3
+
+
+def _add_mixed(X1, Y1, Z1, x2, y2, inf2):
+    """Jacobian += affine (z2 = 1), branch-free.
+
+    Handles: P1 infinite -> P2 lifted; P2 infinite -> P1; P1 == P2 ->
+    doubling; P1 == -P2 -> infinity.
+    """
+    inf1 = is_zero(Z1)
+    Z1Z1 = sqr_mod_p(Z1)
+    U2 = mul_mod_p(x2, Z1Z1)
+    S2 = mul_mod_p(y2, mul_mod_p(Z1, Z1Z1))
+    H = sub_mod_p(U2, X1)
+    R = sub_mod_p(S2, Y1)
+    h_zero = is_zero(H)
+    r_zero = is_zero(R)
+    same_point = h_zero & r_zero & ~inf1 & ~inf2
+    negated = h_zero & ~r_zero & ~inf1 & ~inf2  # P1 == -P2
+
+    HH = sqr_mod_p(H)
+    HHH = mul_mod_p(H, HH)
+    U1HH = mul_mod_p(X1, HH)
+    X3 = sub_mod_p(
+        sub_mod_p(sqr_mod_p(R), HHH), add_mod_p(U1HH, U1HH)
+    )
+    Y3 = sub_mod_p(
+        mul_mod_p(R, sub_mod_p(U1HH, X3)), mul_mod_p(Y1, HHH)
+    )
+    Z3 = mul_mod_p(Z1, H)
+
+    dX, dY, dZ = _double(X1, Y1, Z1)
+
+    one = jnp.zeros_like(X1).at[..., 0].set(1)
+    zero = jnp.zeros_like(X1)
+
+    # priority: P2 inf -> P1; P1 inf -> lift(P2); same -> double;
+    # negated -> infinity; else general add
+    X_out = select(same_point, dX, X3)
+    Y_out = select(same_point, dY, Y3)
+    Z_out = select(same_point, dZ, Z3)
+    Z_out = select(negated, zero, Z_out)
+    X_out = select(inf1, x2, X_out)
+    Y_out = select(inf1, y2, Y_out)
+    Z_out = select(inf1, jnp.where(inf2[..., None], zero, one), Z_out)
+    X_out = select(inf2, X1, X_out)
+    Y_out = select(inf2, Y1, Y_out)
+    Z_out = select(inf2, Z1, Z_out)
+    return X_out, Y_out, Z_out
+
+
+# ---------------------------------------------------------------------------
+# Shamir ladder kernel
+# ---------------------------------------------------------------------------
+
+
+def _shamir_kernel(
+    u1: jnp.ndarray,  # [B, 16] scalar limbs
+    u2: jnp.ndarray,  # [B, 16]
+    table_x: jnp.ndarray,  # [B, 4, 16]  (index 0 unused, 1=G, 2=Q, 3=G+Q)
+    table_y: jnp.ndarray,  # [B, 4, 16]
+    table_inf: jnp.ndarray,  # [B, 4] bool
+    r: jnp.ndarray,  # [B, 16] signature r
+    rn: jnp.ndarray,  # [B, 16] r + n (only checked when rn_ok)
+    rn_ok: jnp.ndarray,  # [B] bool: r + n < p
+) -> jnp.ndarray:
+    """Returns [B] bool: u1*G + u2*Q has x-coordinate === r (mod n)."""
+    B = u1.shape[0]
+    X = jnp.zeros((B, NLIMB), dtype=jnp.uint32)
+    Y = jnp.zeros((B, NLIMB), dtype=jnp.uint32)
+    Z = jnp.zeros((B, NLIMB), dtype=jnp.uint32)  # infinity
+
+    def body(i, state):
+        X, Y, Z = state
+        bit = 255 - i
+        limb_i = bit // fl.LIMB_BITS
+        shift = bit % fl.LIMB_BITS
+        b1 = (jax.lax.dynamic_index_in_dim(u1, limb_i, axis=1, keepdims=False) >> shift) & 1
+        b2 = (jax.lax.dynamic_index_in_dim(u2, limb_i, axis=1, keepdims=False) >> shift) & 1
+        sel = (b1 + 2 * b2).astype(jnp.int32)  # [B] in {0,1,2,3}
+
+        X, Y, Z = _double(X, Y, Z)
+
+        ax = jnp.take_along_axis(table_x, sel[:, None, None], axis=1)[:, 0]
+        ay = jnp.take_along_axis(table_y, sel[:, None, None], axis=1)[:, 0]
+        ainf = jnp.take_along_axis(table_inf, sel[:, None], axis=1)[:, 0]
+        ainf = ainf | (sel == 0)
+
+        X, Y, Z = _add_mixed(X, Y, Z, ax, ay, ainf)
+        return X, Y, Z
+
+    X, Y, Z = jax.lax.fori_loop(0, 256, body, (X, Y, Z))
+
+    not_inf = ~is_zero(Z)
+    Z2 = sqr_mod_p(Z)
+    lhs = X  # X === x * Z^2
+    ok_r = eq(lhs, mul_mod_p(r, Z2))
+    ok_rn = eq(lhs, mul_mod_p(rn, Z2)) & rn_ok
+    return not_inf & (ok_r | ok_rn)
+
+
+_kernel_jit = jax.jit(_shamir_kernel)
+
+# Fixed device batch: every call is padded to a multiple of this, so the
+# kernel compiles once. 64 lanes is negligible waste on TPU vector units.
+TILE = 64
+
+
+def warmup() -> None:
+    """Compile the kernel ahead of the gossip hot path (call at node init
+    when the accelerator flag is on)."""
+    dummy = [((ref.GX, ref.GY), b"\x00" * 32, 1, 1)]
+    batch_verify(dummy)
+
+
+# ---------------------------------------------------------------------------
+# Host wrapper
+# ---------------------------------------------------------------------------
+
+
+def _prepare(
+    items: Sequence[Tuple[Tuple[int, int], bytes, int, int]]
+) -> Tuple[np.ndarray, ...]:
+    """items: [(pub(x,y), msg_hash bytes, r, s)] -> device-ready arrays.
+
+    Host-side rejects (bad range, off-curve) are encoded by a pre-mask;
+    their slots get dummy-but-wellformed data so the kernel stays uniform.
+    """
+    B = len(items)
+    pre_ok = np.zeros(B, dtype=bool)
+    u1s: List[int] = []
+    u2s: List[int] = []
+    tx = np.zeros((B, 4, NLIMB), dtype=np.uint32)
+    ty = np.zeros((B, 4, NLIMB), dtype=np.uint32)
+    tinf = np.ones((B, 4), dtype=bool)
+    rs: List[int] = []
+    rns: List[int] = []
+    rn_ok = np.zeros(B, dtype=bool)
+
+    g_limbs = (int_to_limbs(ref.GX), int_to_limbs(ref.GY))
+
+    for b, (pub, msg_hash, r, s) in enumerate(items):
+        if not (1 <= r < ref.N and 1 <= s < ref.N) or not ref.is_on_curve(pub):
+            u1s.append(1)
+            u2s.append(1)
+            rs.append(1)
+            rns.append(1)
+            continue
+        pre_ok[b] = True
+        e = ref._bits2int(msg_hash)
+        w = pow(s, -1, ref.N)
+        u1s.append((e * w) % ref.N)
+        u2s.append((r * w) % ref.N)
+        rs.append(r)
+        rn = r + ref.N
+        rns.append(rn if rn < ref.P else 1)
+        rn_ok[b] = rn < ref.P
+        # table: 1 = G, 2 = Q, 3 = G + Q
+        tx[b, 1], ty[b, 1] = g_limbs
+        tinf[b, 1] = False
+        tx[b, 2] = int_to_limbs(pub[0])
+        ty[b, 2] = int_to_limbs(pub[1])
+        tinf[b, 2] = False
+        gq = ref.point_add(ref.G, pub)
+        if gq is not None:
+            tx[b, 3] = int_to_limbs(gq[0])
+            ty[b, 3] = int_to_limbs(gq[1])
+            tinf[b, 3] = False
+
+    return (
+        pre_ok,
+        ints_to_limbs(u1s),
+        ints_to_limbs(u2s),
+        tx,
+        ty,
+        tinf,
+        ints_to_limbs(rs),
+        ints_to_limbs(rns),
+        rn_ok,
+    )
+
+
+def batch_verify(
+    items: Sequence[Tuple[Tuple[int, int], bytes, int, int]]
+) -> np.ndarray:
+    """Verify a batch of ECDSA signatures; returns [B] bool.
+
+    items: [(pub(x,y), msg_hash, r, s)]. Semantics identical to
+    babble_tpu.crypto.secp256k1.verify applied elementwise.
+    """
+    if len(items) == 0:
+        return np.zeros(0, dtype=bool)
+    n = len(items)
+    # Pad to a multiple of one fixed tile size so XLA compiles exactly one
+    # kernel, ever — variable batch sizes would each trigger a ~15 s
+    # compile, which would stall the gossip hot path.
+    padded = ((n + TILE - 1) // TILE) * TILE
+    dummy = ((ref.GX, ref.GY), b"\x00" * 32, 1, 1)
+    items = list(items) + [dummy] * (padded - n)
+    pre_ok, u1, u2, tx, ty, tinf, r, rn, rn_ok = _prepare(items)
+    outs = []
+    for t in range(padded // TILE):
+        sl = slice(t * TILE, (t + 1) * TILE)
+        outs.append(
+            _kernel_jit(
+                jnp.asarray(u1[sl]),
+                jnp.asarray(u2[sl]),
+                jnp.asarray(tx[sl]),
+                jnp.asarray(ty[sl]),
+                jnp.asarray(tinf[sl]),
+                jnp.asarray(r[sl]),
+                jnp.asarray(rn[sl]),
+                jnp.asarray(rn_ok[sl]),
+            )
+        )
+    out = np.concatenate([np.asarray(o) for o in outs])
+    return (out & pre_ok)[:n]
+
+
+def prevalidate_events(events) -> None:
+    """Batch-verify the signatures of a list of hashgraph Events on the
+    accelerator and cache the verdicts on the events, so the subsequent
+    per-event ``Event.verify()`` in the insert path
+    (babble_tpu/hashgraph/hashgraph.py insert_event; reference
+    hashgraph.go:672-687) becomes a cache hit.
+
+    Each event contributes one item for the creator signature plus one per
+    internal transaction; the event verdict is the AND of its items.
+    Structurally invalid items (undecodable signature / off-curve key) fail
+    host-side, same as the scalar path.
+    """
+    from babble_tpu.crypto.keys import decode_signature
+
+    items: List[Tuple[Tuple[int, int], bytes, int, int]] = []
+    spans: List[Tuple[object, int, int, bool]] = []
+    for ev in events:
+        start = len(items)
+        ok_static = True
+        try:
+            pub = ref.unmarshal_pubkey(ev.body.creator)
+            r, s = decode_signature(ev.signature)
+            items.append((pub, ev.hash(), r, s))
+        except Exception:
+            ok_static = False
+        if ok_static:
+            for itx in ev.body.internal_transactions:
+                try:
+                    ipub = ref.unmarshal_pubkey(itx.body.peer.public_key().bytes())
+                    ir, is_ = decode_signature(itx.signature)
+                    items.append((ipub, itx.body.hash(), ir, is_))
+                except Exception:
+                    ok_static = False
+                    break
+        spans.append((ev, start, len(items) - start, ok_static))
+
+    results = batch_verify(items)
+    for ev, start, count, ok_static in spans:
+        ok = ok_static and bool(results[start : start + count].all())
+        ev.prevalidate(ok)
+
+
+class BatchVerifier:
+    """Accumulates (pub, hash, r, s) work items and flushes them through the
+    device kernel in one batch — the tpu-side replacement for the tight
+    per-event verify in the reference insert path (hashgraph.go:672-687).
+    """
+
+    def __init__(self) -> None:
+        self._items: List[Tuple[Tuple[int, int], bytes, int, int]] = []
+
+    def add(self, pub: Tuple[int, int], msg_hash: bytes, r: int, s: int) -> int:
+        self._items.append((pub, msg_hash, r, s))
+        return len(self._items) - 1
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def flush(self) -> np.ndarray:
+        out = batch_verify(self._items)
+        self._items = []
+        return out

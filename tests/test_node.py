@@ -28,7 +28,12 @@ from babble_tpu.peers.peer_set import PeerSet
 from babble_tpu.proxy.proxy import InmemProxy
 
 
-def make_cluster(n: int, network: InmemNetwork, heartbeat: float = 0.02):
+def make_cluster(
+    n: int,
+    network: InmemNetwork,
+    heartbeat: float = 0.02,
+    accelerator: bool = False,
+):
     """Build n wired-up nodes over a shared inmem network
     (reference harness: node_test.go:287-417)."""
     keys = [generate_key() for _ in range(n)]
@@ -54,6 +59,7 @@ def make_cluster(n: int, network: InmemNetwork, heartbeat: float = 0.02):
             slow_heartbeat_timeout=0.2,
             moniker=f"node{i}",
             log_level="warning",
+            accelerator=accelerator,
         )
         trans = network.new_transport(addr_of[pub])
         st = DummyState()
@@ -144,6 +150,21 @@ def test_gossip_four_nodes_identical_blocks():
         # the dummy app states also agree
         h0 = nodes[0].get_block(2).state_hash()
         assert h0 != b""
+    finally:
+        shutdown_all(nodes)
+
+
+def test_gossip_with_accelerated_verify():
+    """Same checkGossip oracle with the TPU batch-verify path enabled:
+    incoming sync batches are signature-checked through the JAX kernel
+    (babble_tpu/ops/verify.py) instead of per-event host ECDSA."""
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(2, network, accelerator=True)
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=1, timeout=120.0)
+        check_gossip(nodes, 0, 1)
     finally:
         shutdown_all(nodes)
 
