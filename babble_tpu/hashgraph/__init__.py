@@ -44,6 +44,7 @@ from babble_tpu.hashgraph.internal_transaction import (
     TransactionType,
 )
 from babble_tpu.hashgraph.round_info import RoundEvent, RoundInfo
+from babble_tpu.hashgraph.persistent_store import PersistentStore
 from babble_tpu.hashgraph.store import InmemStore, Store
 
 __all__ = [
@@ -58,6 +59,7 @@ __all__ = [
     "FrameEvent",
     "Hashgraph",
     "InmemStore",
+    "PersistentStore",
     "InternalTransaction",
     "InternalTransactionBody",
     "InternalTransactionReceipt",

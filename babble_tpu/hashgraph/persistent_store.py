@@ -1,0 +1,325 @@
+"""PersistentStore — write-through durable store over SQLite.
+
+The tpu-native equivalent of the reference's BadgerStore
+(/root/reference/src/hashgraph/badger_store.go:28-100): an InmemStore
+LRU cache in front, with every event/round/block/frame/peer-set written
+through to an embedded KV (SQLite, stdlib — this image ships no badger).
+Reads fall back to the DB on cache miss or rolling-index eviction
+(TooLate), mirroring badger_store.go:293-310.
+
+Bootstrap (`--bootstrap`) replays the whole DB topologically through
+consensus to rebuild in-memory state — "WE CAN ONLY BOOTSTRAP FROM 0"
+(reference: hashgraph.go:1481-1536); Hashgraph.bootstrap drives it via
+``topological_events`` and flips ``set_maintenance_mode`` so the replay
+doesn't rewrite the DB.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind
+from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.hashgraph.block import Block
+from babble_tpu.hashgraph.event import Event, EventBody
+from babble_tpu.hashgraph.frame import Frame, Root
+from babble_tpu.hashgraph.round_info import RoundInfo
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS events (
+    key TEXT PRIMARY KEY, topo INTEGER NOT NULL, data TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS events_topo ON events(topo);
+CREATE TABLE IF NOT EXISTS participant_events (
+    participant TEXT NOT NULL, idx INTEGER NOT NULL, hash TEXT NOT NULL,
+    PRIMARY KEY (participant, idx));
+CREATE TABLE IF NOT EXISTS rounds (idx INTEGER PRIMARY KEY, data TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS blocks (idx INTEGER PRIMARY KEY, data TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS frames (round INTEGER PRIMARY KEY, data TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS peer_sets (round INTEGER PRIMARY KEY, data TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS roots (participant TEXT PRIMARY KEY, data TEXT NOT NULL);
+"""
+
+
+class PersistentStore:
+    """Write-through store: InmemStore cache + SQLite persistence."""
+
+    def __init__(self, cache_size: int = 10000, path: str = "babble.db"):
+        self._path = path
+        self._inmem = InmemStore(cache_size)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db_lock = threading.Lock()
+        with self._db_lock:
+            self._db.executescript(_SCHEMA)
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute("PRAGMA synchronous=NORMAL")
+            row = self._db.execute("SELECT MAX(topo) FROM events").fetchone()
+        self._next_topo = (row[0] + 1) if row and row[0] is not None else 0
+        # maintenanceMode disables DB writes during bootstrap replay
+        # (reference: badger_store.go:848-855)
+        self._maintenance = False
+
+    # -- maintenance --------------------------------------------------------
+
+    def set_maintenance_mode(self, on: bool) -> None:
+        self._maintenance = on
+
+    # -- passthroughs to the cache -----------------------------------------
+
+    def cache_size(self) -> int:
+        return self._inmem.cache_size()
+
+    def get_all_peer_sets(self) -> Dict[int, List[Peer]]:
+        return self._inmem.get_all_peer_sets()
+
+    def first_round(self, participant_id: int):
+        return self._inmem.first_round(participant_id)
+
+    def repertoire_by_pub_key(self) -> Dict[str, Peer]:
+        return self._inmem.repertoire_by_pub_key()
+
+    def repertoire_by_id(self) -> Dict[int, Peer]:
+        return self._inmem.repertoire_by_id()
+
+    def known_events(self) -> Dict[int, int]:
+        return self._inmem.known_events()
+
+    def consensus_events(self) -> List[str]:
+        return self._inmem.consensus_events()
+
+    def consensus_events_count(self) -> int:
+        return self._inmem.consensus_events_count()
+
+    def add_consensus_event(self, event: Event) -> None:
+        self._inmem.add_consensus_event(event)
+
+    def last_event_from(self, participant: str) -> str:
+        return self._inmem.last_event_from(participant)
+
+    def last_consensus_event_from(self, participant: str) -> str:
+        return self._inmem.last_consensus_event_from(participant)
+
+    def last_round(self) -> int:
+        return self._inmem.last_round()
+
+    def last_block_index(self) -> int:
+        return self._inmem.last_block_index()
+
+    def round_witnesses(self, round_index: int) -> List[str]:
+        try:
+            return self.get_round(round_index).witnesses()
+        except StoreError:
+            return []
+
+    def round_events(self, round_index: int) -> int:
+        try:
+            return len(self.get_round(round_index).created_events)
+        except StoreError:
+            return 0
+
+    def get_root(self, participant: str) -> Root:
+        try:
+            return self._inmem.get_root(participant)
+        except StoreError:
+            row = self._fetch(
+                "SELECT data FROM roots WHERE participant = ?", (participant,)
+            )
+            if row is None:
+                raise
+            return Root.from_dict(json.loads(row[0]))
+
+    # -- peer sets (write-through) -----------------------------------------
+
+    def get_peer_set(self, round: int) -> PeerSet:
+        return self._inmem.get_peer_set(round)
+
+    def set_peer_set(self, round: int, peer_set: PeerSet) -> None:
+        self._inmem.set_peer_set(round, peer_set)
+        self._write(
+            "INSERT OR REPLACE INTO peer_sets (round, data) VALUES (?, ?)",
+            (round, canonical_dumps([p.to_dict() for p in peer_set.peers]).decode()),
+        )
+
+    # -- events -------------------------------------------------------------
+
+    def get_event(self, hash_: str) -> Event:
+        try:
+            return self._inmem.get_event(hash_)
+        except StoreError:
+            row = self._fetch("SELECT data FROM events WHERE key = ?", (hash_,))
+            if row is None:
+                raise
+            return _event_from_json(row[0])
+
+    def set_event(self, event: Event) -> None:
+        self._inmem.set_event(event)
+        if self._maintenance:
+            return
+        key = event.hex()
+        d = {"Body": event.body.to_dict(), "Signature": event.signature}
+        with self._db_lock:
+            cur = self._db.execute("SELECT topo FROM events WHERE key = ?", (key,))
+            row = cur.fetchone()
+            topo = row[0] if row else self._next_topo
+            if row is None:
+                self._next_topo += 1
+                self._db.execute(
+                    "INSERT OR REPLACE INTO participant_events "
+                    "(participant, idx, hash) VALUES (?, ?, ?)",
+                    (event.creator(), event.index(), key),
+                )
+            self._db.execute(
+                "INSERT OR REPLACE INTO events (key, topo, data) VALUES (?, ?, ?)",
+                (key, topo, canonical_dumps(d).decode()),
+            )
+            self._db.commit()
+
+    def participant_events(self, participant: str, skip: int) -> List[str]:
+        try:
+            return self._inmem.participant_events(participant, skip)
+        except StoreError as err:
+            if err.kind != StoreErrorKind.TOO_LATE:
+                raise
+            with self._db_lock:
+                rows = self._db.execute(
+                    "SELECT hash FROM participant_events "
+                    "WHERE participant = ? AND idx > ? ORDER BY idx",
+                    (participant, skip),
+                ).fetchall()
+            return [r[0] for r in rows]
+
+    def participant_event(self, participant: str, index: int) -> str:
+        """Cache first; DB fallback on eviction (badger_store.go:293-310)."""
+        try:
+            return self._inmem.participant_event(participant, index)
+        except StoreError:
+            row = self._fetch(
+                "SELECT hash FROM participant_events "
+                "WHERE participant = ? AND idx = ?",
+                (participant, index),
+            )
+            if row is None:
+                raise
+            return row[0]
+
+    # -- rounds -------------------------------------------------------------
+
+    def get_round(self, round_index: int) -> RoundInfo:
+        try:
+            return self._inmem.get_round(round_index)
+        except StoreError:
+            row = self._fetch(
+                "SELECT data FROM rounds WHERE idx = ?", (round_index,)
+            )
+            if row is None:
+                raise
+            return RoundInfo.from_dict(json.loads(row[0]))
+
+    def set_round(self, round_index: int, round_info: RoundInfo) -> None:
+        self._inmem.set_round(round_index, round_info)
+        self._write(
+            "INSERT OR REPLACE INTO rounds (idx, data) VALUES (?, ?)",
+            (round_index, canonical_dumps(round_info.to_dict()).decode()),
+        )
+
+    # -- blocks -------------------------------------------------------------
+
+    def get_block(self, index: int) -> Block:
+        try:
+            return self._inmem.get_block(index)
+        except StoreError:
+            row = self._fetch("SELECT data FROM blocks WHERE idx = ?", (index,))
+            if row is None:
+                raise
+            return Block.from_dict(json.loads(row[0]))
+
+    def set_block(self, block: Block) -> None:
+        self._inmem.set_block(block)
+        self._write(
+            "INSERT OR REPLACE INTO blocks (idx, data) VALUES (?, ?)",
+            (block.index(), canonical_dumps(block.to_dict()).decode()),
+        )
+
+    # -- frames -------------------------------------------------------------
+
+    def get_frame(self, round_received: int) -> Frame:
+        try:
+            return self._inmem.get_frame(round_received)
+        except StoreError:
+            row = self._fetch(
+                "SELECT data FROM frames WHERE round = ?", (round_received,)
+            )
+            if row is None:
+                raise
+            return Frame.from_dict(json.loads(row[0]))
+
+    def set_frame(self, frame: Frame) -> None:
+        self._inmem.set_frame(frame)
+        self._write(
+            "INSERT OR REPLACE INTO frames (round, data) VALUES (?, ?)",
+            (frame.round, canonical_dumps(frame.to_dict()).decode()),
+        )
+
+    # -- bootstrap support ---------------------------------------------------
+
+    def topological_events(self, skip: int, count: int) -> List[Event]:
+        """Events in insert order, for bootstrap replay
+        (reference: badger_store.go dbTopologicalEvents / hashgraph.go:1481)."""
+        with self._db_lock:
+            rows = self._db.execute(
+                "SELECT data FROM events ORDER BY topo LIMIT ? OFFSET ?",
+                (count, skip),
+            ).fetchall()
+        return [_event_from_json(r[0]) for r in rows]
+
+    def db_last_block_index(self) -> int:
+        row = self._fetch("SELECT MAX(idx) FROM blocks", ())
+        return row[0] if row and row[0] is not None else -1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reset(self, frame: Frame) -> None:
+        """Reset the cache from a frame; the DB keeps accumulating (the
+        reference's badger Reset also only clears the in-memory half)."""
+        self._inmem.reset(frame)
+        for participant, root in frame.roots.items():
+            self._write(
+                "INSERT OR REPLACE INTO roots (participant, data) VALUES (?, ?)",
+                (participant, canonical_dumps(root.to_dict()).decode()),
+            )
+        self.set_frame(frame)
+
+    def close(self) -> None:
+        with self._db_lock:
+            self._db.commit()
+            self._db.close()
+
+    def store_path(self) -> str:
+        return self._path
+
+    # -- helpers -------------------------------------------------------------
+
+    def _fetch(self, sql: str, args: tuple) -> Optional[tuple]:
+        with self._db_lock:
+            return self._db.execute(sql, args).fetchone()
+
+    def _write(self, sql: str, args: tuple) -> None:
+        if self._maintenance:
+            return
+        with self._db_lock:
+            self._db.execute(sql, args)
+            self._db.commit()
+
+
+def _event_from_json(data: str) -> Event:
+    d = json.loads(data)
+    return Event(EventBody.from_dict(d["Body"]), signature=d["Signature"])
