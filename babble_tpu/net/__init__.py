@@ -14,6 +14,7 @@ from .rpc import (
 )
 from .transport import Transport, TransportError
 from .inmem import InmemNetwork, InmemTransport
+from .tcp import TCPTransport
 
 __all__ = [
     "RPC",
@@ -29,4 +30,5 @@ __all__ = [
     "TransportError",
     "InmemNetwork",
     "InmemTransport",
+    "TCPTransport",
 ]

@@ -1,0 +1,228 @@
+"""TCP transport: typed RPC framing over pooled sockets.
+
+Wire format mirrors the reference's (adapted-from-raft) framing
+(/root/reference/src/net/net_transport.go:39-50,274-441): one RPC type
+byte, then the JSON request; the response is an error string + JSON
+payload. Here both directions are length-prefixed (4-byte big-endian)
+JSON — same shape, explicit frame boundaries — with bytes fields base64
+encoded by the canonical codec.
+
+Server side: an accept loop; each connection gets a handler thread that
+decodes requests, parks them on the node's consumer queue as RPC
+envelopes, and relays the node's response (net_transport.go:321-441).
+Client side: a per-target connection pool capped at ``max_pool``
+(net_transport.go:161-219).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from ..crypto.canonical import canonical_dumps
+from .rpc import (
+    REQUEST_TYPES,
+    RESPONSE_TYPES,
+    RPC,
+    TYPE_OF_REQUEST,
+)
+from .transport import TransportError
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, type_byte: Optional[int], payload: bytes) -> None:
+    head = bytes([type_byte]) if type_byte is not None else b""
+    sock.sendall(head + struct.pack(">I", len(payload)) + payload)
+
+
+class TCPTransport:
+    """reference: net/tcp_transport.go:18-77 + net_transport.go."""
+
+    def __init__(
+        self,
+        bind_addr: str,
+        advertise_addr: Optional[str] = None,
+        max_pool: int = 3,
+        timeout: float = 10.0,
+    ):
+        self._bind_addr = bind_addr
+        self._advertise = advertise_addr or bind_addr
+        self._timeout = timeout
+        self._max_pool = max_pool
+        self._consumer: "queue.Queue[RPC]" = queue.Queue()
+        self._listener: Optional[socket.socket] = None
+        self._pool: Dict[str, List[socket.socket]] = {}
+        self._pool_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- Transport interface -------------------------------------------------
+
+    def consumer(self) -> "queue.Queue[RPC]":
+        return self._consumer
+
+    def local_addr(self) -> str:
+        return self._bind_addr
+
+    def advertise_addr(self) -> str:
+        return self._advertise
+
+    def listen(self) -> None:
+        if self._listener is not None:  # idempotent (Node.init also calls it)
+            return
+        host, port_s = self._bind_addr.rsplit(":", 1)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host or "0.0.0.0", int(port_s)))
+        srv.listen(64)
+        self._listener = srv
+        # rewrite port 0 to the assigned one so tests can bind ephemeral
+        if int(port_s) == 0:
+            port = srv.getsockname()[1]
+            self._bind_addr = f"{host}:{port}"
+            if self._advertise.endswith(":0"):
+                self._advertise = f"{self._advertise.rsplit(':', 1)[0]}:{port}"
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        self._shutdown.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._pool_lock:
+            for conns in self._pool.values():
+                for c in conns:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+            self._pool.clear()
+
+    # -- server side ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        """One request/response at a time per connection
+        (reference: net_transport.go:355-441)."""
+        try:
+            while not self._shutdown.is_set():
+                type_byte = _recv_exact(conn, 1)[0]
+                (length,) = struct.unpack(">I", _recv_exact(conn, 4))
+                payload = _recv_exact(conn, length)
+                req_cls = REQUEST_TYPES.get(type_byte)
+                if req_cls is None:
+                    _send_frame(
+                        conn,
+                        None,
+                        canonical_dumps(
+                            {"error": f"unknown rpc type {type_byte}", "payload": None}
+                        ),
+                    )
+                    continue
+                command = req_cls.from_dict(json.loads(payload))
+                rpc = RPC(command)
+                self._consumer.put(rpc)
+                try:
+                    result, error = rpc.wait(timeout=self._timeout)
+                except queue.Empty:
+                    result, error = None, "rpc handler timeout"
+                body = {
+                    "error": error,
+                    "payload": result.to_dict() if result is not None else None,
+                }
+                _send_frame(conn, None, canonical_dumps(body))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- client side ---------------------------------------------------------
+
+    def _checkout(self, target: str) -> socket.socket:
+        with self._pool_lock:
+            conns = self._pool.get(target)
+            if conns:
+                return conns.pop()
+        host, port_s = target.rsplit(":", 1)
+        try:
+            sock = socket.create_connection(
+                (host, int(port_s)), timeout=self._timeout
+            )
+        except OSError as err:
+            raise TransportError(f"dial {target}: {err}") from err
+        sock.settimeout(self._timeout)
+        return sock
+
+    def _checkin(self, target: str, sock: socket.socket) -> None:
+        with self._pool_lock:
+            conns = self._pool.setdefault(target, [])
+            if len(conns) < self._max_pool:
+                conns.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _request(self, target: str, req):
+        type_byte = TYPE_OF_REQUEST[type(req)]
+        sock = self._checkout(target)
+        try:
+            _send_frame(sock, type_byte, canonical_dumps(req.to_dict()))
+            (length,) = struct.unpack(">I", _recv_exact(sock, 4))
+            body = json.loads(_recv_exact(sock, length))
+        except (OSError, ConnectionError, struct.error, ValueError) as err:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise TransportError(f"rpc to {target}: {err}") from err
+        self._checkin(target, sock)
+        if body.get("error"):
+            raise TransportError(f"remote error from {target}: {body['error']}")
+        resp_cls = RESPONSE_TYPES[type_byte]
+        return resp_cls.from_dict(body["payload"])
+
+    def sync(self, target: str, req):
+        return self._request(target, req)
+
+    def eager_sync(self, target: str, req):
+        return self._request(target, req)
+
+    def fast_forward(self, target: str, req):
+        return self._request(target, req)
+
+    def join(self, target: str, req):
+        return self._request(target, req)

@@ -1,0 +1,224 @@
+"""Table-driven transport suite: every RPC pair over both the inmem and
+TCP transports (reference: /root/reference/src/net/transport_test.go:91-520),
+plus a full-node gossip run over localhost TCP (node_test.go tier 4)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.event import WireBody, WireEvent
+from babble_tpu.hashgraph.internal_transaction import InternalTransaction
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.net.rpc import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    JoinRequest,
+    JoinResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from babble_tpu.net.tcp import TCPTransport
+from babble_tpu.net.transport import TransportError
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+
+def _wire_event() -> WireEvent:
+    return WireEvent(
+        body=WireBody(
+            transactions=[b"t1", b"t2"],
+            creator_id=7,
+            other_parent_creator_id=3,
+            index=4,
+            self_parent_index=3,
+            other_parent_index=2,
+            timestamp=99,
+        ),
+        signature="abc|def",
+    )
+
+
+def _responder(trans, responses: dict, stop: threading.Event):
+    """Serve canned responses keyed by request class name."""
+
+    def run():
+        while not stop.is_set():
+            try:
+                rpc = trans.consumer().get(timeout=0.1)
+            except Exception:
+                continue
+            key = type(rpc.command).__name__
+            resp = responses.get(key)
+            if isinstance(resp, str):
+                rpc.respond(None, resp)
+            else:
+                rpc.respond(resp, None)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _make_pair(kind):
+    """Returns (client, server, cleanup)."""
+    if kind == "inmem":
+        net = InmemNetwork()
+        a = net.new_transport("inmem://a")
+        b = net.new_transport("inmem://b")
+        return a, b, lambda: (a.close(), b.close())
+    srv = TCPTransport("127.0.0.1:0")
+    srv.listen()
+    cli = TCPTransport("127.0.0.1:0")
+    cli.listen()
+    return cli, srv, lambda: (cli.close(), srv.close())
+
+
+@pytest.fixture(params=["inmem", "tcp"])
+def pair(request):
+    cli, srv, cleanup = _make_pair(request.param)
+    stop = threading.Event()
+    yield cli, srv, stop
+    stop.set()
+    cleanup()
+
+
+def test_sync_rpc(pair):
+    cli, srv, stop = pair
+    want = SyncResponse(from_id=2, events=[_wire_event()], known={1: 5, 2: 9})
+    _responder(srv, {"SyncRequest": want}, stop)
+    got = cli.sync(
+        srv.advertise_addr(), SyncRequest(from_id=1, known={1: 2}, sync_limit=500)
+    )
+    assert got.from_id == 2
+    assert got.known == {1: 5, 2: 9}
+    assert len(got.events) == 1
+    assert got.events[0].body.transactions == [b"t1", b"t2"]
+    assert got.events[0].signature == "abc|def"
+
+
+def test_eager_sync_rpc(pair):
+    cli, srv, stop = pair
+    _responder(srv, {"EagerSyncRequest": EagerSyncResponse(2, True)}, stop)
+    got = cli.eager_sync(
+        srv.advertise_addr(),
+        EagerSyncRequest(from_id=1, events=[_wire_event()]),
+    )
+    assert got.success is True
+
+
+def test_fast_forward_rpc(pair):
+    cli, srv, stop = pair
+    want = FastForwardResponse(from_id=2, block=None, frame=None, snapshot=b"\x01\x02")
+    _responder(srv, {"FastForwardRequest": want}, stop)
+    got = cli.fast_forward(srv.advertise_addr(), FastForwardRequest(from_id=1))
+    assert got.snapshot == b"\x01\x02"
+
+
+def test_join_rpc(pair):
+    cli, srv, stop = pair
+    k = generate_key()
+    peer = Peer("tcp://x", k.public_key.hex(), "joiner")
+    itx = InternalTransaction.join(peer)
+    itx.sign(k)
+    want = JoinResponse(from_id=2, accepted=True, accepted_round=11, peers=[peer])
+    _responder(srv, {"JoinRequest": want}, stop)
+    got = cli.join(srv.advertise_addr(), JoinRequest(internal_transaction=itx))
+    assert got.accepted is True
+    assert got.accepted_round == 11
+    assert got.peers[0].pub_key_hex == peer.pub_key_hex
+
+
+def test_remote_error_propagates(pair):
+    cli, srv, stop = pair
+    _responder(srv, {"SyncRequest": "something broke"}, stop)
+    with pytest.raises(TransportError):
+        cli.sync(
+            srv.advertise_addr(), SyncRequest(from_id=1, known={}, sync_limit=10)
+        )
+
+
+def test_dial_failure():
+    cli = TCPTransport("127.0.0.1:0")
+    with pytest.raises(TransportError):
+        cli.sync(
+            "127.0.0.1:1", SyncRequest(from_id=1, known={}, sync_limit=10)
+        )
+    cli.close()
+
+
+def test_gossip_over_tcp():
+    """3 full nodes over real localhost TCP sockets reach identical blocks
+    (reference: node_test.go full-node tier with real TCP)."""
+    n = 3
+    keys = [generate_key() for _ in range(n)]
+    transports = []
+    for _ in range(n):
+        t = TCPTransport("127.0.0.1:0")
+        t.listen()
+        transports.append(t)
+    peers = PeerSet(
+        [
+            Peer(transports[i].advertise_addr(), k.public_key.hex(), f"n{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    trans_of = {
+        transports[i].advertise_addr(): transports[i] for i in range(n)
+    }
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.02,
+            slow_heartbeat_timeout=0.2,
+            moniker=f"n{i}",
+            log_level="warning",
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        addr = next(
+            p.net_addr for p in peers.peers if p.pub_key_hex == k.public_key.hex()
+        )
+        node = Node(
+            conf,
+            Validator(k, f"n{i}"),
+            peers,
+            peers,
+            InmemStore(conf.cache_size),
+            trans_of[addr],
+            pr,
+        )
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    try:
+        for nd in nodes:
+            nd.run_async()
+        deadline = time.monotonic() + 60
+        i = 0
+        while (
+            min(nd.get_last_block_index() for nd in nodes) < 1
+            and time.monotonic() < deadline
+        ):
+            proxies[i % n].submit_tx(f"tx {i}".encode())
+            i += 1
+            time.sleep(0.005)
+        assert min(nd.get_last_block_index() for nd in nodes) >= 1
+        b0 = [nodes[0].get_block(j).body.hash() for j in range(2)]
+        for nd in nodes[1:]:
+            assert [nd.get_block(j).body.hash() for j in range(2)] == b0
+    finally:
+        for nd in nodes:
+            nd.shutdown()
