@@ -12,11 +12,14 @@ from .proxy import (
     ProxyHandler,
     dummy_commit_response,
 )
+from .socket_proxy import SocketAppProxy, SocketBabbleProxy
 
 __all__ = [
     "AppProxy",
     "CommitResponse",
     "InmemProxy",
     "ProxyHandler",
+    "SocketAppProxy",
+    "SocketBabbleProxy",
     "dummy_commit_response",
 ]
