@@ -1,0 +1,184 @@
+"""babble_tpu CLI: keygen | run | version.
+
+Reference semantics: /root/reference/cmd/babble/main.go:10,
+commands/keygen.go:21-60, commands/run.go:14-141 — config resolution is
+layered: built-in defaults < ``babble.toml`` in the datadir < CLI flags
+(run.go:112-141). The reference uses cobra+viper; here argparse +
+stdlib tomllib.
+
+Usage:
+    python -m babble_tpu.cli keygen [--pem FILE]
+    python -m babble_tpu.cli run [--datadir D] [--listen H:P] ...
+    python -m babble_tpu.cli version
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+from ..config.config import Config, default_data_dir
+from ..crypto.keyfile import SimpleKeyfile
+from ..crypto.keys import generate_key
+from ..version import __version__ as VERSION
+
+# flag name -> (Config attr, type)
+_RUN_FLAGS = {
+    "datadir": ("data_dir", str),
+    "log": ("log_level", str),
+    "listen": ("bind_addr", str),
+    "advertise": ("advertise_addr", str),
+    "service_listen": ("service_addr", str),
+    "no_service": ("no_service", bool),
+    "heartbeat": ("heartbeat_timeout", float),
+    "slow_heartbeat": ("slow_heartbeat_timeout", float),
+    "timeout": ("tcp_timeout", float),
+    "join_timeout": ("join_timeout", float),
+    "max_pool": ("max_pool", int),
+    "cache_size": ("cache_size", int),
+    "sync_limit": ("sync_limit", int),
+    "suspend_limit": ("suspend_limit", int),
+    "fast_sync": ("enable_fast_sync", bool),
+    "store": ("store", bool),
+    "db": ("database_dir", str),
+    "bootstrap": ("bootstrap", bool),
+    "maintenance_mode": ("maintenance_mode", bool),
+    "moniker": ("moniker", str),
+    "accelerator": ("accelerator", bool),
+}
+
+
+def _load_toml(path: str) -> dict:
+    """babble.toml layer (reference: run.go:112-141)."""
+    try:
+        import tomllib
+    except ImportError:  # pragma: no cover - py<3.11
+        return {}
+    try:
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    except FileNotFoundError:
+        return {}
+
+
+def _build_config(args: argparse.Namespace) -> Config:
+    datadir = args.datadir or default_data_dir()
+    layered: dict = {"data_dir": datadir}
+    # layer 2: babble.toml
+    toml_conf = _load_toml(os.path.join(datadir, "babble.toml"))
+    for flag, (attr, typ) in _RUN_FLAGS.items():
+        if flag in toml_conf:
+            layered[attr] = typ(toml_conf[flag])
+    # layer 3: explicit CLI flags beat the file
+    for flag, (attr, _) in _RUN_FLAGS.items():
+        v = getattr(args, flag, None)
+        if v is not None and v is not False:
+            layered[attr] = v
+    return Config(**layered)
+
+
+def cmd_keygen(args: argparse.Namespace) -> int:
+    """Generate a key pair; refuses to overwrite (keygen.go:33-52)."""
+    datadir = args.datadir or default_data_dir()
+    path = args.pem or os.path.join(datadir, "priv_key")
+    if os.path.exists(path):
+        print(
+            f"A key already lives under: {path}\n"
+            "Remove it first if you really want to overwrite.",
+            file=sys.stderr,
+        )
+        return 1
+    key = generate_key()
+    SimpleKeyfile(path).write_key(key)
+    print(f"Your private key has been saved to: {path}")
+    print(f"Public key: {key.public_key.hex()}")
+    return 0
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    """Assemble and run the engine with a socket app proxy, or the dummy
+    in-memory app with --inmem-dummy (run.go:29-60)."""
+    from ..engine import Babble
+
+    conf = _build_config(args)
+    proxy = None
+    if not args.inmem_dummy:
+        from ..proxy.socket_proxy import SocketAppProxy
+
+        proxy = SocketAppProxy(args.proxy_listen, args.client_connect)
+    engine = Babble(conf, proxy=proxy)
+    engine.init()
+
+    def _stop(signum, frame):
+        engine.shutdown()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    engine.run()
+    return 0
+
+
+def cmd_version(_: argparse.Namespace) -> int:
+    print(VERSION)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="babble_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    kg = sub.add_parser("keygen", help="generate a new key pair")
+    kg.add_argument("--datadir", default=None)
+    kg.add_argument("--pem", default=None, help="explicit key file path")
+    kg.set_defaults(fn=cmd_keygen)
+
+    run = sub.add_parser("run", help="run a node")
+    run.add_argument("--datadir", default=None)
+    run.add_argument("--log", default=None)
+    run.add_argument("--listen", default=None, help="bind host:port")
+    run.add_argument("--advertise", default=None)
+    run.add_argument("--service-listen", dest="service_listen", default=None)
+    run.add_argument("--no-service", dest="no_service", action="store_true")
+    run.add_argument("--heartbeat", type=float, default=None)
+    run.add_argument("--slow-heartbeat", dest="slow_heartbeat", type=float, default=None)
+    run.add_argument("--timeout", type=float, default=None)
+    run.add_argument("--join-timeout", dest="join_timeout", type=float, default=None)
+    run.add_argument("--max-pool", dest="max_pool", type=int, default=None)
+    run.add_argument("--cache-size", dest="cache_size", type=int, default=None)
+    run.add_argument("--sync-limit", dest="sync_limit", type=int, default=None)
+    run.add_argument("--suspend-limit", dest="suspend_limit", type=int, default=None)
+    run.add_argument("--fast-sync", dest="fast_sync", action="store_true")
+    run.add_argument("--store", action="store_true")
+    run.add_argument("--db", default=None)
+    run.add_argument("--bootstrap", action="store_true")
+    run.add_argument("--maintenance-mode", dest="maintenance_mode", action="store_true")
+    run.add_argument("--moniker", default=None)
+    run.add_argument("--accelerator", action="store_true")
+    run.add_argument(
+        "--proxy-listen", dest="proxy_listen", default="127.0.0.1:1338",
+        help="where Babble serves SubmitTx for the app",
+    )
+    run.add_argument(
+        "--client-connect", dest="client_connect", default="127.0.0.1:1339",
+        help="where the app serves State.*",
+    )
+    run.add_argument(
+        "--inmem-dummy", dest="inmem_dummy", action="store_true",
+        help="run the built-in dummy app in-process instead of the socket proxy",
+    )
+    run.set_defaults(fn=cmd_run)
+
+    ver = sub.add_parser("version", help="print the version")
+    ver.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -300,8 +300,11 @@ class PersistentStore:
 
     def close(self) -> None:
         with self._db_lock:
+            if self._db is None:
+                return
             self._db.commit()
             self._db.close()
+            self._db = None
 
     def store_path(self) -> str:
         return self._path

@@ -1,0 +1,134 @@
+"""HTTP observability service.
+
+Reference semantics: /root/reference/src/service/service.go:20-272 —
+endpoints /stats, /block/{index}, /blocks/{start}?count=, /graph, /peers,
+/genesispeers, /validators/{round}, /history. Built on the stdlib
+ThreadingHTTPServer (the reference rides http.DefaultServeMux so an
+in-process app can share the port; here an app can mount extra handlers
+via ``extra_routes``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..crypto.canonical import canonical_dumps
+from ..node.graph import Graph
+
+GET_BLOCKS_LIMIT = 50  # max blocks per /blocks/ page (service.go:126)
+
+
+def _jsonable(obj) -> object:
+    return json.loads(canonical_dumps(obj))
+
+
+class Service:
+    """reference: service/service.go:20-86."""
+
+    def __init__(self, bind_addr: str, node, logger=None,
+                 extra_routes: Optional[Dict[str, Callable]] = None):
+        self.bind_addr = bind_addr
+        self.node = node
+        self.logger = logger
+        self.extra_routes = extra_routes or {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve_async(self) -> None:
+        host, port_s = self.bind_addr.rsplit(":", 1)
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route into our logger
+                if service.logger:
+                    service.logger.debug("service: " + fmt % args)
+
+            def do_GET(self):
+                service._handle(self)
+
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port_s)), Handler)
+        self.bind_addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- routing ------------------------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path
+        try:
+            if path in self.extra_routes:
+                self.extra_routes[path](req)
+                return
+            if path == "/stats":
+                body = self.node.get_stats()
+            elif path.startswith("/block/"):
+                body = _jsonable(
+                    self.node.get_block(int(path[len("/block/"):])).to_dict()
+                )
+            elif path.startswith("/blocks/"):
+                body = self._blocks(path, parsed.query)
+            elif path == "/graph":
+                body = Graph(self.node).to_dict()
+            elif path == "/peers":
+                body = _jsonable([p.to_dict() for p in self.node.get_peers()])
+            elif path == "/genesispeers":
+                body = _jsonable(
+                    [p.to_dict() for p in self.node.get_validator_set(0)]
+                )
+            elif path.startswith("/validators/"):
+                rnd = int(path[len("/validators/"):])
+                body = _jsonable(
+                    [p.to_dict() for p in self.node.get_validator_set(rnd)]
+                )
+            elif path == "/history":
+                body = _jsonable(
+                    {
+                        str(r): [p.to_dict() for p in ps]
+                        for r, ps in self.node.get_all_validator_sets().items()
+                    }
+                )
+            else:
+                self._send(req, 404, {"error": f"no route {path}"})
+                return
+        except Exception as err:
+            self._send(req, 500, {"error": str(err)})
+            return
+        self._send(req, 200, body)
+
+    def _blocks(self, path: str, query: str):
+        """/blocks/{startIndex}?count=N, newest-last, capped at 50
+        (service.go:126-190)."""
+        start = int(path[len("/blocks/"):])
+        qs = parse_qs(query)
+        count = min(
+            int(qs.get("count", [GET_BLOCKS_LIMIT])[0]), GET_BLOCKS_LIMIT
+        )
+        last = self.node.get_last_block_index()
+        if start > last:
+            raise ValueError(f"requested starting index {start} > last block {last}")
+        out = []
+        for i in range(start, min(start + count, last + 1)):
+            out.append(_jsonable(self.node.get_block(i).to_dict()))
+        return out
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, body) -> None:
+        payload = json.dumps(body).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)

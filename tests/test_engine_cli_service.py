@@ -1,0 +1,178 @@
+"""Engine assembly + CLI + HTTP service tests.
+
+Reference models: babble/babble_test.go:17-77 (engine smoke),
+cmd/babble keygen behavior, service/service.go endpoints."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import List
+
+import pytest
+
+from babble_tpu.cli.main import main as cli_main
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keyfile import SimpleKeyfile
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.engine import Babble
+from babble_tpu.peers.json_peer_set import JSONPeerSet
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+
+
+def _setup_datadirs(tmp_path, n: int, base_port: int):
+    """keygen + peers.json for an n-node testnet on localhost."""
+    keys = [generate_key() for _ in range(n)]
+    peers = PeerSet(
+        [
+            Peer(f"127.0.0.1:{base_port + i}", k.public_key.hex(), f"n{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    datadirs = []
+    for i, k in enumerate(keys):
+        d = tmp_path / f"node{i}"
+        d.mkdir()
+        SimpleKeyfile(str(d / "priv_key")).write_key(k)
+        JSONPeerSet(str(d)).write(peers)
+        datadirs.append(d)
+    return keys, peers, datadirs
+
+
+def test_engine_testnet_with_service(tmp_path):
+    """Two engines assembled purely from datadirs gossip to a block; the
+    HTTP service exposes stats/blocks/peers/graph."""
+    keys, peers, datadirs = _setup_datadirs(tmp_path, 2, 20100)
+    engines: List[Babble] = []
+    for i, d in enumerate(datadirs):
+        conf = Config(
+            data_dir=str(d),
+            bind_addr=f"127.0.0.1:{20100 + i}",
+            service_addr="127.0.0.1:0",
+            heartbeat_timeout=0.02,
+            slow_heartbeat_timeout=0.2,
+            moniker=f"n{i}",
+            log_level="warning",
+            no_service=(i == 1),
+        )
+        e = Babble(conf)
+        e.init()
+        engines.append(e)
+    try:
+        for e in engines:
+            e.run_async()
+        deadline = time.monotonic() + 60
+        i = 0
+        while (
+            min(e.node.get_last_block_index() for e in engines) < 1
+            and time.monotonic() < deadline
+        ):
+            engines[i % 2].proxy.submit_tx(f"tx {i}".encode())
+            i += 1
+            time.sleep(0.005)
+        assert min(e.node.get_last_block_index() for e in engines) >= 1
+
+        # HTTP service of engine 0
+        svc = engines[0].service
+        assert svc is not None
+        base = f"http://{svc.bind_addr}"
+
+        stats = json.loads(urllib.request.urlopen(f"{base}/stats").read())
+        assert stats["state"] == "Babbling"
+        assert int(stats["last_block_index"]) >= 1
+
+        block0 = json.loads(urllib.request.urlopen(f"{base}/block/0").read())
+        assert block0["Body"]["Index"] == 0
+
+        blocks = json.loads(
+            urllib.request.urlopen(f"{base}/blocks/0?count=2").read()
+        )
+        assert [b["Body"]["Index"] for b in blocks] == [0, 1]
+
+        got_peers = json.loads(urllib.request.urlopen(f"{base}/peers").read())
+        assert len(got_peers) == 2
+        genesis = json.loads(
+            urllib.request.urlopen(f"{base}/genesispeers").read()
+        )
+        assert len(genesis) == 2
+
+        graph = json.loads(urllib.request.urlopen(f"{base}/graph").read())
+        assert len(graph["ParticipantEvents"]) == 2
+        assert len(graph["Blocks"]) >= 2
+
+        history = json.loads(urllib.request.urlopen(f"{base}/history").read())
+        assert "0" in history
+
+        # unknown route -> 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+    finally:
+        for e in engines:
+            e.shutdown()
+
+
+def test_engine_persistent_store_backup(tmp_path):
+    """A stale DB is moved aside when starting without --bootstrap
+    (reference: babble.go:246-287)."""
+    keys, peers, datadirs = _setup_datadirs(tmp_path, 1, 20200)
+    # ephemeral bind: nothing dials a single-node engine, and the first
+    # engine's port can still be in teardown when the second starts
+    conf = dict(
+        data_dir=str(datadirs[0]),
+        bind_addr="127.0.0.1:0",
+        no_service=True,
+        store=True,
+        log_level="warning",
+    )
+    e = Babble(Config(**conf))
+    e.init()
+    db = e.store.store_path()
+    e.shutdown()
+
+    e2 = Babble(Config(**conf))
+    e2.init()
+    e2.shutdown()
+    import glob
+    import os
+
+    assert os.path.exists(db)
+    assert glob.glob(db + ".*.bak"), "old DB should be backed up"
+
+
+def test_cli_keygen_and_version(tmp_path, capsys):
+    rc = cli_main(["keygen", "--datadir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Public key: 0X" in out
+    key = SimpleKeyfile(str(tmp_path / "priv_key")).read_key()
+    assert key.public_key.hex().startswith("0X")
+
+    # refuses to overwrite
+    rc = cli_main(["keygen", "--datadir", str(tmp_path)])
+    assert rc == 1
+
+    rc = cli_main(["version"])
+    assert rc == 0
+    assert capsys.readouterr().out.strip().count(".") == 2
+
+
+def test_cli_config_layering(tmp_path):
+    """defaults < babble.toml < flags (reference: run.go:112-141)."""
+    import argparse
+
+    from babble_tpu.cli.main import _build_config, build_parser
+
+    (tmp_path / "babble.toml").write_text(
+        'moniker = "from-toml"\nsync_limit = 123\ncache_size = 777\n'
+    )
+    parser = build_parser()
+    args = parser.parse_args(
+        ["run", "--datadir", str(tmp_path), "--sync-limit", "456"]
+    )
+    conf = _build_config(args)
+    assert conf.moniker == "from-toml"  # from file
+    assert conf.cache_size == 777  # from file
+    assert conf.sync_limit == 456  # flag beats file
+    assert conf.heartbeat_timeout == 0.010  # default survives
