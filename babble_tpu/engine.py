@@ -74,6 +74,12 @@ class Babble:
         if os.path.exists(db_path) and not self.config.bootstrap:
             backup = f"{db_path}.{time.strftime('%Y%m%d%H%M%S')}.bak"
             shutil.move(db_path, backup)
+            # Take the WAL/SHM sidecars along, or SQLite would replay the
+            # stale WAL frames into the brand-new database.
+            for ext in ("-wal", "-shm"):
+                side = db_path + ext
+                if os.path.exists(side):
+                    shutil.move(side, backup + ext)
             self.logger.info("backed up existing database to %s", backup)
         self.store = PersistentStore(self.config.cache_size, db_path)
 
@@ -85,7 +91,8 @@ class Babble:
             self.config.bind_addr,
             advertise_addr=self.config.advertise_addr or None,
             max_pool=self.config.max_pool,
-            timeout=self.config.tcp_timeout + self.config.join_timeout,
+            timeout=self.config.tcp_timeout,
+            join_timeout=self.config.join_timeout,
         )
         self.transport.listen()
 

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from ..crypto.canonical import canonical_dumps
 from .rpc import (
+    JoinRequest,
     REQUEST_TYPES,
     RESPONSE_TYPES,
     RPC,
@@ -57,10 +58,17 @@ class TCPTransport:
         advertise_addr: Optional[str] = None,
         max_pool: int = 3,
         timeout: float = 10.0,
+        join_timeout: Optional[float] = None,
     ):
         self._bind_addr = bind_addr
         self._advertise = advertise_addr or bind_addr
         self._timeout = timeout
+        # Join/leave RPCs block on consensus server-side, so they get their
+        # own, much longer deadline (reference keeps these separate:
+        # node_rpc.go join waits JoinTimeout while syncs use TCPTimeout).
+        self._join_timeout = join_timeout if join_timeout is not None else max(
+            timeout, 10.0
+        )
         self._max_pool = max_pool
         self._consumer: "queue.Queue[RPC]" = queue.Queue()
         self._listener: Optional[socket.socket] = None
@@ -151,8 +159,15 @@ class TCPTransport:
                 command = req_cls.from_dict(json.loads(payload))
                 rpc = RPC(command)
                 self._consumer.put(rpc)
+                # Joins park on a consensus promise in the handler; give the
+                # node's own join deadline room to fire first (+2 s margin).
+                wait_timeout = (
+                    self._join_timeout + 2.0
+                    if isinstance(command, JoinRequest)
+                    else self._timeout
+                )
                 try:
-                    result, error = rpc.wait(timeout=self._timeout)
+                    result, error = rpc.wait(timeout=wait_timeout)
                 except queue.Empty:
                     result, error = None, "rpc handler timeout"
                 body = {
@@ -186,6 +201,7 @@ class TCPTransport:
         return sock
 
     def _checkin(self, target: str, sock: socket.socket) -> None:
+        sock.settimeout(self._timeout)  # undo any per-request deadline
         with self._pool_lock:
             conns = self._pool.setdefault(target, [])
             if len(conns) < self._max_pool:
@@ -196,10 +212,12 @@ class TCPTransport:
         except OSError:
             pass
 
-    def _request(self, target: str, req):
+    def _request(self, target: str, req, timeout: Optional[float] = None):
         type_byte = TYPE_OF_REQUEST[type(req)]
         sock = self._checkout(target)
         try:
+            if timeout is not None:
+                sock.settimeout(timeout)
             _send_frame(sock, type_byte, canonical_dumps(req.to_dict()))
             (length,) = struct.unpack(">I", _recv_exact(sock, 4))
             body = json.loads(_recv_exact(sock, length))
@@ -225,4 +243,4 @@ class TCPTransport:
         return self._request(target, req)
 
     def join(self, target: str, req):
-        return self._request(target, req)
+        return self._request(target, req, timeout=self._join_timeout + 4.0)

@@ -1,0 +1,277 @@
+"""Dynamic membership end-to-end tests: join, leave, rejoin.
+
+Modeled on the reference's node_dyn_test.go
+(/root/reference/src/node/node_dyn_test.go:37-170 — TestJoinRequest,
+TestLeaveRequest, TestJoinFull, TestRejoin): full in-process nodes over
+the inmem transport, with PEER_ADD / PEER_REMOVE internal transactions
+going through consensus and taking effect at round_received + 6
+(core.go:562-650).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.node import Node
+from babble_tpu.node.state import State
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+from test_node import (
+    bombard_and_wait,
+    check_gossip,
+    make_cluster,
+    shutdown_all,
+)
+
+
+def make_extra_node(
+    network: InmemNetwork,
+    current_peers: PeerSet,
+    genesis_peers: PeerSet,
+    name: str,
+    key=None,
+    heartbeat: float = 0.02,
+) -> tuple[Node, InmemProxy]:
+    """A node whose key is NOT in current_peers — it must Join
+    (reference harness: node_dyn_test.go:37-60)."""
+    key = key or generate_key()
+    conf = Config(
+        heartbeat_timeout=heartbeat,
+        slow_heartbeat_timeout=0.2,
+        moniker=name,
+        log_level="warning",
+        join_timeout=30.0,
+    )
+    trans = network.new_transport(f"inmem://{name}")
+    st = DummyState()
+    proxy = InmemProxy(st)
+    node = Node(
+        conf,
+        Validator(key, name),
+        current_peers,
+        genesis_peers,
+        InmemStore(conf.cache_size),
+        trans,
+        proxy,
+    )
+    node.init()
+    return node, proxy
+
+
+class Bombardier:
+    """Continuous background transaction load (reference:
+    node_test.go:613-631 makeRandomTransactions)."""
+
+    def __init__(self, proxies: List[InmemProxy], interval: float = 0.005):
+        self.proxies = proxies
+        self.interval = interval
+        self._stop = threading.Event()
+        self._t: Optional[threading.Thread] = None
+        self._i = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.proxies[self._i % len(self.proxies)].submit_tx(
+                f"dyn tx {self._i}".encode()
+            )
+            self._i += 1
+            time.sleep(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._t:
+            self._t.join(timeout=2.0)
+
+
+def wait_until(pred, timeout: float, msg: str):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            pytest.fail(f"timeout: {msg}")
+        time.sleep(0.05)
+
+
+def test_join_request():
+    """A new node joins a running 3-node cluster and ends up in every
+    node's validator set (reference: node_dyn_test.go TestJoinRequest)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    genesis = nodes[0].core.genesis_peers
+    bomb = Bombardier(proxies).start()
+    joiner = None
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_start = nodes[0].get_last_block_index()
+
+        joiner, jproxy = make_extra_node(
+            network, nodes[0].core.peers, genesis, "joiner"
+        )
+        assert joiner.get_state() == State.JOINING
+        joiner.run_async()
+
+        wait_until(
+            lambda: joiner.get_state() == State.BABBLING,
+            60.0,
+            "joiner never reached BABBLING",
+        )
+        jid = joiner.get_id()
+        wait_until(
+            lambda: all(jid in n.core.validators.by_id for n in nodes),
+            60.0,
+            "joiner never entered the cluster validator sets",
+        )
+        # the joiner itself learns its own membership by replaying consensus
+        wait_until(
+            lambda: jid in joiner.core.validators.by_id,
+            60.0,
+            "joiner never saw its own PEER_ADD commit",
+        )
+        assert joiner.core.accepted_round > bombard_start
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if joiner is not None:
+            joiner.shutdown()
+
+
+def test_join_full():
+    """After joining, the new node participates in consensus and holds a
+    byte-identical chain (reference: node_dyn_test.go TestJoinFull)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    genesis = nodes[0].core.genesis_peers
+    bomb = Bombardier(proxies).start()
+    joiner = None
+    try:
+        for n in nodes:
+            n.run_async()
+
+        joiner, jproxy = make_extra_node(
+            network, nodes[0].core.peers, genesis, "joiner"
+        )
+        joiner.run_async()
+        wait_until(
+            lambda: joiner.get_state() == State.BABBLING
+            and joiner.get_id() in joiner.core.validators.by_id,
+            60.0,
+            "joiner never fully joined",
+        )
+        bomb.stop()
+
+        everyone = nodes + [joiner]
+        target = max(n.get_last_block_index() for n in everyone) + 2
+        bombard_and_wait(everyone, proxies + [jproxy], target, timeout=90.0)
+        check_gossip(everyone, 0, target)
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if joiner is not None:
+            joiner.shutdown()
+
+
+def test_leave_request():
+    """A node leaves politely; the remaining validators shrink and keep
+    committing (reference: node_dyn_test.go TestLeaveRequest)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    bomb = Bombardier(proxies[:3]).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        wait_until(
+            lambda: all(n.get_last_block_index() >= 0 for n in nodes),
+            30.0,
+            "cluster never committed block 0",
+        )
+
+        leaver = nodes[3]
+        lid = leaver.get_id()
+        leaver.leave()
+        assert leaver.get_state() == State.SHUTDOWN
+
+        wait_until(
+            lambda: all(
+                lid not in n.core.validators.by_id for n in nodes[:3]
+            ),
+            60.0,
+            "leaver never removed from validator sets",
+        )
+        # the survivors keep committing blocks
+        cur = max(n.get_last_block_index() for n in nodes[:3])
+        bombard_and_wait(nodes[:3], proxies[:3], cur + 2, timeout=60.0)
+        check_gossip(nodes[:3], 0, cur + 2)
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+
+
+def test_rejoin():
+    """Leave then rejoin with the same key: the node re-enters through the
+    Joining path and converges again (reference: node_dyn_test.go
+    TestRejoin)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    genesis = nodes[0].core.genesis_peers
+    bomb = Bombardier(proxies[:2]).start()
+    rejoined = None
+    try:
+        for n in nodes:
+            n.run_async()
+        wait_until(
+            lambda: all(n.get_last_block_index() >= 0 for n in nodes),
+            30.0,
+            "cluster never committed block 0",
+        )
+
+        leaver = nodes[2]
+        lkey = leaver.core.validator.key
+        lid = leaver.get_id()
+        leaver.leave()
+        wait_until(
+            lambda: all(
+                lid not in n.core.validators.by_id for n in nodes[:2]
+            ),
+            60.0,
+            "leaver never removed",
+        )
+
+        # same key, fresh store, new transport address
+        rejoined, rproxy = make_extra_node(
+            network, nodes[0].core.peers, genesis, "rejoiner", key=lkey
+        )
+        assert rejoined.get_state() == State.JOINING
+        rejoined.run_async()
+        wait_until(
+            lambda: rejoined.get_state() == State.BABBLING
+            and all(lid in n.core.validators.by_id for n in nodes[:2]),
+            60.0,
+            "rejoin never completed",
+        )
+        bomb.stop()
+
+        everyone = nodes[:2] + [rejoined]
+        target = max(n.get_last_block_index() for n in everyone) + 2
+        bombard_and_wait(everyone, proxies[:2] + [rproxy], target, timeout=90.0)
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if rejoined is not None:
+            rejoined.shutdown()
