@@ -86,10 +86,19 @@ class InmemTransport:
     """Channel-routed fake network endpoint
     (reference: inmem_transport.go:34-80)."""
 
-    def __init__(self, network: InmemNetwork, addr: str, timeout: float = 5.0):
+    def __init__(
+        self,
+        network: InmemNetwork,
+        addr: str,
+        timeout: float = 5.0,
+        join_timeout: float = 30.0,
+    ):
         self.network = network
         self.addr = addr
         self.timeout = timeout
+        # Joins block on consensus server-side; give them their own longer
+        # deadline, mirroring the TCP transport's split.
+        self.join_timeout = max(join_timeout, timeout)
         self.closed = False
         self._consumer: "queue.Queue[RPC]" = queue.Queue()
 
@@ -117,7 +126,7 @@ class InmemTransport:
         return self.network.request(self.addr, target, req, self.timeout)
 
     def join(self, target: str, req: JoinRequest) -> JoinResponse:
-        return self.network.request(self.addr, target, req, self.timeout)
+        return self.network.request(self.addr, target, req, self.join_timeout)
 
     def close(self) -> None:
         self.closed = True

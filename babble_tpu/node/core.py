@@ -255,7 +255,7 @@ class Core:
     def fast_forward(self, block: Block, frame: Frame) -> None:
         """Reset the hashgraph from a trusted Block+Frame
         (reference: core.go:367-402)."""
-        peer_set = PeerSet(frame.peers)
+        peer_set = frame.peers
 
         self.hg.check_block(block, peer_set)
 
