@@ -1,0 +1,85 @@
+"""Host-side batch signature prevalidation via the native C++ library.
+
+The gossip sync path hands every decoded chunk of incoming events here;
+one foreign call verifies all creator + internal-transaction signatures
+and caches verdicts on the events, making the per-event ``Event.verify()``
+in the insert path a cache hit. This mirrors the accelerator-side
+``babble_tpu.ops.verify.prevalidate_events`` (which shares the collector
+below) but runs on the host CPU — the default fast path when no TPU batch
+kernel is configured.
+
+Reference hot loop being replaced: per-event secp256k1 verification at
+insert (src/hashgraph/hashgraph.go:672-687 -> src/crypto/keys/signature.go:20).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from babble_tpu import native_crypto
+from babble_tpu.crypto import secp256k1 as ref
+from babble_tpu.crypto.keys import decode_signature
+
+# ((x, y), msg_hash, r, s)
+SigItem = Tuple[Tuple[int, int], bytes, int, int]
+# (event, first_item_index, item_count, statically_ok)
+SigSpan = Tuple[object, int, int, bool]
+
+
+def available() -> bool:
+    return native_crypto.available()
+
+
+def collect_signature_items(events) -> Tuple[List[SigItem], List[SigSpan]]:
+    """Gather every verifiable signature of a list of Events: the creator
+    signature plus one per internal transaction. Structurally invalid
+    items (undecodable signature / malformed key) mark the whole event
+    statically failed, same as the scalar verify path. Shared by the host
+    (native C++) and accelerator (JAX) batch verifiers so what counts as a
+    consensus-relevant signature can never diverge between them."""
+    items: List[SigItem] = []
+    spans: List[SigSpan] = []
+    for ev in events:
+        start = len(items)
+        ok_static = True
+        try:
+            pub = ref.unmarshal_pubkey(ev.body.creator)
+            r, s = decode_signature(ev.signature)
+            items.append((pub, ev.hash(), r, s))
+        except Exception:
+            ok_static = False
+        if ok_static:
+            for itx in ev.body.internal_transactions:
+                try:
+                    ipub = ref.unmarshal_pubkey(
+                        itx.body.peer.public_key().bytes()
+                    )
+                    ir, is_ = decode_signature(itx.signature)
+                    items.append((ipub, itx.body.hash(), ir, is_))
+                except Exception:
+                    ok_static = False
+                    break
+        spans.append((ev, start, len(items) - start, ok_static))
+    return items, spans
+
+
+def prevalidate_events_host(events) -> bool:
+    """Batch-verify signatures for a list of Events in one native call.
+
+    Returns False (leaving events untouched, so the scalar path runs)
+    when the native library is unavailable.
+    """
+    items, spans = collect_signature_items(events)
+    pubs = [
+        x.to_bytes(32, "big") + y.to_bytes(32, "big") for (x, y), _, _, _ in items
+    ]
+    msgs = [m for _, m, _, _ in items]
+    rss = [(r, s) for _, _, r, s in items]
+
+    results = native_crypto.verify_batch(pubs, msgs, rss)
+    if results is None:
+        return False
+    for ev, start, count, ok_static in spans:
+        ok = ok_static and all(results[start : start + count])
+        ev.prevalidate(ok)
+    return True

@@ -130,6 +130,16 @@ class PublicKey:
         return self.verify_rs(msg_hash, r, s)
 
     def verify_rs(self, msg_hash: bytes, r: int, s: int) -> bool:
+        from babble_tpu import native_crypto
+
+        res = native_crypto.verify_one(
+            self.x.to_bytes(32, "big") + self.y.to_bytes(32, "big"),
+            msg_hash,
+            r,
+            s,
+        )
+        if res is not None:
+            return res
         if _HAVE_OPENSSL:
             try:
                 pub = _openssl_pub(self.x, self.y)
@@ -168,6 +178,9 @@ class PrivateKey:
         return encode_signature(r, s)
 
     def sign_rs(self, msg_hash: bytes) -> Tuple[int, int]:
+        # Signing touches the private key, so constant-time OpenSSL stays
+        # preferred; the variable-time native signer is only a fallback
+        # (verification is secret-free and uses native first).
         if _HAVE_OPENSSL:
             try:
                 der = _openssl_priv(self.d).sign(
@@ -175,7 +188,15 @@ class PrivateKey:
                 )
                 return _decode_dss(der)
             except Exception:
-                pass  # fall through to pure python on backend errors
+                pass  # fall through on backend errors
+        from babble_tpu import native_crypto
+
+        try:
+            rs = native_crypto.sign(self.d.to_bytes(32, "big"), msg_hash)
+        except ValueError:
+            rs = None
+        if rs is not None:
+            return rs
         return curve.sign(self.d, msg_hash)
 
     def bytes(self) -> bytes:

@@ -52,7 +52,12 @@ class Core:
     ):
         # Gate the TPU batch-verify path behind a flag (the reference's
         # north-star `--accelerator` switch); jax is only imported when on.
+        # Without the accelerator, incoming sync chunks still batch through
+        # the native C++ verifier when it is available.
         self.accelerated_verify = accelerated_verify
+        from babble_tpu.crypto import batch as _host_batch
+
+        self._host_batch_verify = _host_batch.available()
         self.validator = validator
         self.genesis_peers = genesis_peers
         self.validators = genesis_peers
@@ -142,7 +147,7 @@ class Core:
             decoded: List[Event] = []
             overlay: Dict[tuple, str] = {}
             j = pos
-            if self.accelerated_verify:
+            if self.accelerated_verify or self._host_batch_verify:
                 while j < n:
                     try:
                         ev = self.hg.read_wire_info(unknown_events[j], overlay)
@@ -152,9 +157,16 @@ class Core:
                     decoded.append(ev)
                     j += 1
                 if decoded:
-                    from babble_tpu.ops.verify import prevalidate_events
+                    if self.accelerated_verify:
+                        from babble_tpu.ops.verify import prevalidate_events
 
-                    prevalidate_events(decoded)
+                        prevalidate_events(decoded)
+                    else:
+                        from babble_tpu.crypto.batch import (
+                            prevalidate_events_host,
+                        )
+
+                        prevalidate_events_host(decoded)
             if j == pos:
                 # Sequential path (accelerator off, or chunk stalled at the
                 # first event — let read_wire_info raise its real error).

@@ -297,32 +297,13 @@ def prevalidate_events(events) -> None:
     Each event contributes one item for the creator signature plus one per
     internal transaction; the event verdict is the AND of its items.
     Structurally invalid items (undecodable signature / off-curve key) fail
-    host-side, same as the scalar path.
+    host-side, same as the scalar path. Item collection is shared with the
+    host batch verifier (babble_tpu.crypto.batch) so the two backends can
+    never diverge on what counts as a consensus-relevant signature.
     """
-    from babble_tpu.crypto.keys import decode_signature
+    from babble_tpu.crypto.batch import collect_signature_items
 
-    items: List[Tuple[Tuple[int, int], bytes, int, int]] = []
-    spans: List[Tuple[object, int, int, bool]] = []
-    for ev in events:
-        start = len(items)
-        ok_static = True
-        try:
-            pub = ref.unmarshal_pubkey(ev.body.creator)
-            r, s = decode_signature(ev.signature)
-            items.append((pub, ev.hash(), r, s))
-        except Exception:
-            ok_static = False
-        if ok_static:
-            for itx in ev.body.internal_transactions:
-                try:
-                    ipub = ref.unmarshal_pubkey(itx.body.peer.public_key().bytes())
-                    ir, is_ = decode_signature(itx.signature)
-                    items.append((ipub, itx.body.hash(), ir, is_))
-                except Exception:
-                    ok_static = False
-                    break
-        spans.append((ev, start, len(items) - start, ok_static))
-
+    items, spans = collect_signature_items(events)
     results = batch_verify(items)
     for ev, start, count, ok_static in spans:
         ok = ok_static and bool(results[start : start + count].all())

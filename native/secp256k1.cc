@@ -1,0 +1,766 @@
+// babble_tpu native batch crypto: self-contained secp256k1 ECDSA + SHA-256.
+//
+// This is the framework's native runtime component for host-side signature
+// work: the gossip hot path verifies every incoming event's signature
+// (reference: src/hashgraph/event.go:219-247 via hashgraph.go:672-687) and
+// signs every self-event (src/node/core.go:337-343). The batch C ABI lets
+// Python hand a whole sync's worth of (pubkey, hash, signature) tuples over
+// in ONE call, avoiding per-op FFI overhead.
+//
+// Semantics mirror babble_tpu/crypto/secp256k1.py exactly (differentially
+// tested): RFC 6979 deterministic nonces, NO low-s normalization (matching
+// Go's crypto/ecdsa which the reference uses, keys/signature.go:13-18),
+// e = leftmost 256 bits of the hash, r/s in [1, n-1], pubkey must satisfy
+// the curve equation mod p.
+//
+// Implementation: 4x64-bit limbs with unsigned __int128 accumulation;
+// reduction exploits p = 2^256 - 0x1000003D1 and 2^256 mod n folding;
+// Jacobian coordinates (a=0 doubling), Strauss-Shamir interleaved 4-bit
+// windows for u1*G + u2*Q with a precomputed affine G table.
+//
+// Build: g++ -O3 -shared -fPIC -o libbabble_crypto.so secp256k1.cc
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+typedef uint32_t u32;
+typedef uint8_t u8;
+
+// ---------------------------------------------------------------------------
+// SHA-256 (for RFC 6979 HMAC and the sign-loop rehash)
+// ---------------------------------------------------------------------------
+
+static const u32 SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+struct Sha256 {
+    u32 h[8];
+    u8 buf[64];
+    u64 len;
+    int buflen;
+
+    void init() {
+        static const u32 H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                  0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                  0x1f83d9ab, 0x5be0cd19};
+        memcpy(h, H0, sizeof(h));
+        len = 0;
+        buflen = 0;
+    }
+
+    static u32 rotr(u32 x, int n) { return (x >> n) | (x << (32 - n)); }
+
+    void block(const u8 *p) {
+        u32 w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (u32(p[4 * i]) << 24) | (u32(p[4 * i + 1]) << 16) |
+                   (u32(p[4 * i + 2]) << 8) | u32(p[4 * i + 3]);
+        for (int i = 16; i < 64; i++) {
+            u32 s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+            u32 s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        u32 a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+            g = h[6], hh = h[7];
+        for (int i = 0; i < 64; i++) {
+            u32 S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+            u32 ch = (e & f) ^ (~e & g);
+            u32 t1 = hh + S1 + ch + SHA_K[i] + w[i];
+            u32 S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+            u32 maj = (a & b) ^ (a & c) ^ (b & c);
+            u32 t2 = S0 + maj;
+            hh = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+        h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    }
+
+    void update(const u8 *p, u64 n) {
+        len += n;
+        while (n > 0) {
+            if (buflen == 0 && n >= 64) {
+                block(p);
+                p += 64;
+                n -= 64;
+            } else {
+                int take = int(64 - buflen < (long long)n ? 64 - buflen : n);
+                memcpy(buf + buflen, p, take);
+                buflen += take;
+                p += take;
+                n -= take;
+                if (buflen == 64) {
+                    block(buf);
+                    buflen = 0;
+                }
+            }
+        }
+    }
+
+    void final(u8 out[32]) {
+        u64 bitlen = len * 8;
+        u8 pad = 0x80;
+        update(&pad, 1);
+        u8 z = 0;
+        while (buflen != 56) update(&z, 1);
+        u8 lb[8];
+        for (int i = 0; i < 8; i++) lb[i] = u8(bitlen >> (56 - 8 * i));
+        update(lb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4 * i] = u8(h[i] >> 24);
+            out[4 * i + 1] = u8(h[i] >> 16);
+            out[4 * i + 2] = u8(h[i] >> 8);
+            out[4 * i + 3] = u8(h[i]);
+        }
+    }
+};
+
+static void sha256(const u8 *p, u64 n, u8 out[32]) {
+    Sha256 s;
+    s.init();
+    s.update(p, n);
+    s.final(out);
+}
+
+static void hmac_sha256(const u8 *key, int keylen, const u8 *m1, int n1,
+                        const u8 *m2, int n2, const u8 *m3, int n3,
+                        const u8 *m4, int n4, u8 out[32]) {
+    u8 k[64];
+    memset(k, 0, 64);
+    if (keylen > 64) {
+        sha256(key, keylen, k);
+    } else {
+        memcpy(k, key, keylen);
+    }
+    u8 ipad[64], opad[64];
+    for (int i = 0; i < 64; i++) {
+        ipad[i] = k[i] ^ 0x36;
+        opad[i] = k[i] ^ 0x5c;
+    }
+    Sha256 s;
+    s.init();
+    s.update(ipad, 64);
+    if (n1) s.update(m1, n1);
+    if (n2) s.update(m2, n2);
+    if (n3) s.update(m3, n3);
+    if (n4) s.update(m4, n4);
+    u8 inner[32];
+    s.final(inner);
+    s.init();
+    s.update(opad, 64);
+    s.update(inner, 32);
+    s.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// 256-bit integers, little-endian limbs
+// ---------------------------------------------------------------------------
+
+struct U256 {
+    u64 v[4];
+};
+
+static const U256 P = {{0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                        0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL}};
+static const U256 NORD = {{0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL,
+                           0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL}};
+// 2^256 mod n (129 bits, 3 limbs)
+static const u64 NC[3] = {0x402DA1732FC9BEBFULL, 0x4551231950B75FC4ULL, 1ULL};
+static const u64 PK = 0x1000003D1ULL;  // 2^256 mod p (33 bits)
+
+static void u256_from_be(U256 &r, const u8 b[32]) {
+    for (int i = 0; i < 4; i++) {
+        u64 w = 0;
+        for (int j = 0; j < 8; j++) w = (w << 8) | b[8 * (3 - i) + j];
+        r.v[i] = w;
+    }
+}
+
+static void u256_to_be(u8 b[32], const U256 &a) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            b[8 * (3 - i) + j] = u8(a.v[i] >> (56 - 8 * j));
+}
+
+static bool u256_is_zero(const U256 &a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static bool u256_eq(const U256 &a, const U256 &b) {
+    return a.v[0] == b.v[0] && a.v[1] == b.v[1] && a.v[2] == b.v[2] &&
+           a.v[3] == b.v[3];
+}
+
+// -1, 0, 1
+static int u256_cmp(const U256 &a, const U256 &b) {
+    for (int i = 3; i >= 0; i--) {
+        if (a.v[i] < b.v[i]) return -1;
+        if (a.v[i] > b.v[i]) return 1;
+    }
+    return 0;
+}
+
+// r = a + b, returns carry
+static u64 u256_add(U256 &r, const U256 &a, const U256 &b) {
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (u128)a.v[i] + b.v[i];
+        r.v[i] = (u64)c;
+        c >>= 64;
+    }
+    return (u64)c;
+}
+
+// r = a - b, returns borrow
+static u64 u256_sub(U256 &r, const U256 &a, const U256 &b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a.v[i] - b.v[i] - borrow;
+        r.v[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    return (u64)borrow;
+}
+
+// t[8] = a * b
+static void u256_mul_wide(u64 t[8], const U256 &a, const U256 &b) {
+    memset(t, 0, 8 * sizeof(u64));
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 cur = (u128)t[i + j] + (u128)a.v[i] * b.v[j] + carry;
+            t[i + j] = (u64)cur;
+            carry = cur >> 64;
+        }
+        t[i + 4] = (u64)carry;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Field arithmetic mod p
+// ---------------------------------------------------------------------------
+
+static void fe_reduce_once(U256 &a) {
+    if (u256_cmp(a, P) >= 0) u256_sub(a, a, P);
+}
+
+static void fe_add(U256 &r, const U256 &a, const U256 &b) {
+    u64 c = u256_add(r, a, b);
+    if (c) {
+        // r = r + 2^256 mod p = r + PK
+        U256 k = {{PK, 0, 0, 0}};
+        u256_add(r, r, k);
+    }
+    fe_reduce_once(r);
+}
+
+static void fe_sub(U256 &r, const U256 &a, const U256 &b) {
+    u64 borrow = u256_sub(r, a, b);
+    if (borrow) u256_add(r, r, P);
+}
+
+// reduce 512-bit t mod p using 2^256 ≡ PK
+static void fe_reduce_wide(U256 &r, const u64 t[8]) {
+    u64 m[5];
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (u128)t[4 + i] * PK + t[i];
+        m[i] = (u64)c;
+        c >>= 64;
+    }
+    m[4] = (u64)c;  // < 2^34
+    c = (u128)m[4] * PK + m[0];
+    r.v[0] = (u64)c;
+    c >>= 64;
+    for (int i = 1; i < 4; i++) {
+        c += m[i];
+        r.v[i] = (u64)c;
+        c >>= 64;
+    }
+    if (c) {  // one more 2^256 wrap
+        U256 k = {{PK, 0, 0, 0}};
+        u256_add(r, r, k);
+    }
+    fe_reduce_once(r);
+}
+
+static void fe_mul(U256 &r, const U256 &a, const U256 &b) {
+    u64 t[8];
+    u256_mul_wide(t, a, b);
+    fe_reduce_wide(r, t);
+}
+
+static void fe_sqr(U256 &r, const U256 &a) { fe_mul(r, a, a); }
+
+// r = a^(p-2) mod p  (Fermat inverse)
+static void fe_inv(U256 &r, const U256 &a) {
+    // p - 2
+    U256 e = P;
+    e.v[0] -= 2;
+    U256 result = {{1, 0, 0, 0}};
+    U256 base = a;
+    for (int i = 0; i < 256; i++) {
+        if ((e.v[i / 64] >> (i % 64)) & 1) fe_mul(result, result, base);
+        fe_sqr(base, base);
+    }
+    r = result;
+}
+
+// ---------------------------------------------------------------------------
+// Scalar arithmetic mod n
+// ---------------------------------------------------------------------------
+
+static void sc_reduce_once(U256 &a) {
+    if (u256_cmp(a, NORD) >= 0) u256_sub(a, a, NORD);
+}
+
+// reduce a 512-bit value mod n by folding with 2^256 ≡ NC (129 bits)
+static void sc_reduce_wide(U256 &r, const u64 tin[8]) {
+    u64 t[8];
+    memcpy(t, tin, sizeof(t));
+    // Each fold: t = t_lo + t_hi * NC shrinks the high part by ~127 bits;
+    // three folds bring 512 bits under 2^257.
+    for (int pass = 0; pass < 3; pass++) {
+        u64 hi[4] = {t[4], t[5], t[6], t[7]};
+        if ((hi[0] | hi[1] | hi[2] | hi[3]) == 0) break;
+        u64 prod[7];
+        memset(prod, 0, sizeof(prod));
+        for (int i = 0; i < 4; i++) {
+            u128 carry = 0;
+            for (int j = 0; j < 3; j++) {
+                u128 cur = (u128)prod[i + j] + (u128)hi[i] * NC[j] + carry;
+                prod[i + j] = (u64)cur;
+                carry = cur >> 64;
+            }
+            prod[i + 3] += (u64)carry;
+        }
+        u128 c = 0;
+        for (int i = 0; i < 7; i++) {
+            c += (u128)prod[i] + (i < 4 ? t[i] : 0);
+            t[i] = (u64)c;
+            c >>= 64;
+        }
+        t[7] = (u64)c;
+    }
+    U256 res = {{t[0], t[1], t[2], t[3]}};
+    // after folding, at most a 1-bit high word remains
+    if (t[4] | t[5] | t[6] | t[7]) {
+        u64 hi0 = t[4];
+        u64 prod[4];
+        u128 c = 0;
+        for (int j = 0; j < 3; j++) {
+            c += (u128)hi0 * NC[j];
+            prod[j] = (u64)c;
+            c >>= 64;
+        }
+        prod[3] = (u64)c;
+        U256 add = {{prod[0], prod[1], prod[2], prod[3]}};
+        u64 carry = u256_add(res, res, add);
+        if (carry) {  // wrapped past 2^256: fold once more
+            U256 nc = {{NC[0], NC[1], NC[2], 0}};
+            u256_add(res, res, nc);
+        }
+    }
+    sc_reduce_once(res);
+    sc_reduce_once(res);
+    r = res;
+}
+
+static void sc_mul(U256 &r, const U256 &a, const U256 &b) {
+    u64 t[8];
+    u256_mul_wide(t, a, b);
+    sc_reduce_wide(r, t);
+}
+
+static void sc_add(U256 &r, const U256 &a, const U256 &b) {
+    u64 c = u256_add(r, a, b);
+    if (c) {
+        U256 add = {{NC[0], NC[1], NC[2], 0}};
+        u256_add(r, r, add);
+    }
+    sc_reduce_once(r);
+}
+
+// r = a^(n-2) mod n
+static void sc_inv(U256 &r, const U256 &a) {
+    U256 e = NORD;
+    e.v[0] -= 2;
+    U256 result = {{1, 0, 0, 0}};
+    U256 base = a;
+    for (int i = 0; i < 256; i++) {
+        if ((e.v[i / 64] >> (i % 64)) & 1) sc_mul(result, result, base);
+        sc_mul(base, base, base);
+    }
+    r = result;
+}
+
+// value mod n (for r = x mod n and e handling)
+static void sc_from_u256(U256 &r, const U256 &a) {
+    r = a;
+    sc_reduce_once(r);
+}
+
+// ---------------------------------------------------------------------------
+// Point arithmetic: Jacobian coordinates, curve y^2 = x^3 + 7 (a = 0)
+// ---------------------------------------------------------------------------
+
+struct Jac {
+    U256 X, Y, Z;
+    bool inf;
+};
+
+struct Aff {
+    U256 x, y;
+};
+
+static const Aff G_AFF = {
+    {{0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL, 0x55A06295CE870B07ULL,
+      0x79BE667EF9DCBBACULL}},
+    {{0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL, 0x5DA4FBFC0E1108A8ULL,
+      0x483ADA7726A3C465ULL}}};
+
+static void jac_set_inf(Jac &r) {
+    memset(&r, 0, sizeof(r));
+    r.inf = true;
+}
+
+static void jac_from_aff(Jac &r, const Aff &a) {
+    r.X = a.x;
+    r.Y = a.y;
+    r.Z = {{1, 0, 0, 0}};
+    r.inf = false;
+}
+
+// doubling, a = 0
+static void jac_dbl(Jac &r, const Jac &p) {
+    if (p.inf || u256_is_zero(p.Y)) {
+        jac_set_inf(r);
+        return;
+    }
+    U256 A, B, C, D, E, F, t;
+    fe_sqr(A, p.X);              // A = X^2
+    fe_sqr(B, p.Y);              // B = Y^2
+    fe_sqr(C, B);                // C = B^2
+    fe_add(t, p.X, B);
+    fe_sqr(t, t);
+    fe_sub(t, t, A);
+    fe_sub(t, t, C);
+    fe_add(D, t, t);             // D = 2((X+B)^2 - A - C)
+    fe_add(E, A, A);
+    fe_add(E, E, A);             // E = 3A
+    fe_sqr(F, E);                // F = E^2
+    U256 X3, Y3, Z3;
+    fe_sub(X3, F, D);
+    fe_sub(X3, X3, D);           // X3 = F - 2D
+    fe_sub(t, D, X3);
+    fe_mul(t, E, t);
+    U256 c8;
+    fe_add(c8, C, C);
+    fe_add(c8, c8, c8);
+    fe_add(c8, c8, c8);          // 8C
+    fe_sub(Y3, t, c8);           // Y3 = E(D - X3) - 8C
+    fe_mul(Z3, p.Y, p.Z);
+    fe_add(Z3, Z3, Z3);          // Z3 = 2YZ
+    r.X = X3;
+    r.Y = Y3;
+    r.Z = Z3;
+    r.inf = false;
+}
+
+// general addition
+static void jac_add(Jac &r, const Jac &p, const Jac &q) {
+    if (p.inf) { r = q; return; }
+    if (q.inf) { r = p; return; }
+    U256 Z1Z1, Z2Z2, U1, U2, S1, S2, H, R;
+    fe_sqr(Z1Z1, p.Z);
+    fe_sqr(Z2Z2, q.Z);
+    fe_mul(U1, p.X, Z2Z2);
+    fe_mul(U2, q.X, Z1Z1);
+    U256 t;
+    fe_mul(t, q.Z, Z2Z2);
+    fe_mul(S1, p.Y, t);
+    fe_mul(t, p.Z, Z1Z1);
+    fe_mul(S2, q.Y, t);
+    fe_sub(H, U2, U1);
+    fe_sub(R, S2, S1);
+    if (u256_is_zero(H)) {
+        if (u256_is_zero(R)) {
+            jac_dbl(r, p);
+        } else {
+            jac_set_inf(r);
+        }
+        return;
+    }
+    U256 HH, HHH, V;
+    fe_sqr(HH, H);
+    fe_mul(HHH, HH, H);
+    fe_mul(V, U1, HH);
+    U256 X3, Y3, Z3;
+    fe_sqr(X3, R);
+    fe_sub(X3, X3, HHH);
+    fe_sub(X3, X3, V);
+    fe_sub(X3, X3, V);           // X3 = R^2 - H^3 - 2V
+    fe_sub(t, V, X3);
+    fe_mul(t, R, t);
+    U256 s1hhh;
+    fe_mul(s1hhh, S1, HHH);
+    fe_sub(Y3, t, s1hhh);        // Y3 = R(V - X3) - S1 H^3
+    fe_mul(Z3, p.Z, q.Z);
+    fe_mul(Z3, Z3, H);
+    r.X = X3;
+    r.Y = Y3;
+    r.Z = Z3;
+    r.inf = false;
+}
+
+// mixed addition (q affine, Z2 = 1)
+static void jac_add_aff(Jac &r, const Jac &p, const Aff &q) {
+    Jac jq;
+    jac_from_aff(jq, q);
+    jac_add(r, p, jq);
+}
+
+static void jac_to_aff(Aff &r, const Jac &p) {
+    U256 zi, zi2, zi3;
+    fe_inv(zi, p.Z);
+    fe_sqr(zi2, zi);
+    fe_mul(zi3, zi2, zi);
+    fe_mul(r.x, p.X, zi2);
+    fe_mul(r.y, p.Y, zi3);
+}
+
+// ---------------------------------------------------------------------------
+// Precomputed G table: odd/even multiples 1G..15G (affine) for 4-bit windows
+// ---------------------------------------------------------------------------
+
+static Aff G_TABLE[16];  // [i] = i*G, i in 1..15 ([0] unused)
+static bool g_table_ready = false;
+
+static void init_g_table() {
+    if (g_table_ready) return;
+    Jac acc;
+    jac_from_aff(acc, G_AFF);
+    Jac cur = acc;
+    for (int i = 1; i <= 15; i++) {
+        jac_to_aff(G_TABLE[i], cur);
+        Jac next;
+        jac_add_aff(next, cur, G_AFF);
+        cur = next;
+    }
+    g_table_ready = true;
+}
+
+// scalar * G using the affine table, 4-bit windows MSB-first
+static void mul_base(Jac &r, const U256 &k) {
+    init_g_table();
+    jac_set_inf(r);
+    for (int w = 63; w >= 0; w--) {
+        if (!r.inf)
+            for (int d = 0; d < 4; d++) jac_dbl(r, r);
+        int limb = w / 16;
+        int shift = (w % 16) * 4;
+        int digit = int((k.v[limb] >> shift) & 0xF);
+        if (digit) jac_add_aff(r, r, G_TABLE[digit]);
+    }
+}
+
+// u1*G + u2*Q interleaved (Strauss-Shamir), 4-bit windows
+static void mul_double(Jac &r, const U256 &u1, const U256 &u2, const Aff &q) {
+    init_g_table();
+    Jac qtab[16];  // [i] = i*Q, i in 1..15
+    jac_from_aff(qtab[1], q);
+    for (int i = 2; i <= 15; i++) jac_add_aff(qtab[i], qtab[i - 1], q);
+    jac_set_inf(r);
+    for (int w = 63; w >= 0; w--) {
+        if (!r.inf)
+            for (int d = 0; d < 4; d++) jac_dbl(r, r);
+        int limb = w / 16;
+        int shift = (w % 16) * 4;
+        int d1 = int((u1.v[limb] >> shift) & 0xF);
+        int d2 = int((u2.v[limb] >> shift) & 0xF);
+        if (d1) jac_add_aff(r, r, G_TABLE[d1]);
+        if (d2) jac_add(r, r, qtab[d2]);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// ECDSA
+// ---------------------------------------------------------------------------
+
+// y^2 == x^3 + 7 (mod p)?  Inputs taken mod p, mirroring the Python oracle.
+static bool on_curve(const U256 &x, const U256 &y) {
+    U256 y2, x3, t;
+    fe_sqr(y2, y);
+    fe_sqr(t, x);
+    fe_mul(x3, t, x);
+    U256 seven = {{7, 0, 0, 0}};
+    fe_add(x3, x3, seven);
+    return u256_eq(y2, x3);
+}
+
+static bool verify_one(const u8 pub[64], const u8 msg[32], const u8 rs[64]) {
+    U256 r, s;
+    u256_from_be(r, rs);
+    u256_from_be(s, rs + 32);
+    // r, s in [1, n-1]
+    if (u256_is_zero(r) || u256_is_zero(s)) return false;
+    if (u256_cmp(r, NORD) >= 0 || u256_cmp(s, NORD) >= 0) return false;
+    U256 x, y;
+    u256_from_be(x, pub);
+    u256_from_be(y, pub + 32);
+    fe_reduce_once(x);
+    fe_reduce_once(y);
+    if (!on_curve(x, y)) return false;
+    Aff q = {x, y};
+    U256 e;
+    u256_from_be(e, msg);
+    U256 em;
+    sc_from_u256(em, e);
+    U256 w, u1, u2;
+    sc_inv(w, s);
+    sc_mul(u1, em, w);
+    sc_mul(u2, r, w);
+    Jac pt;
+    if (u256_is_zero(u2)) {
+        mul_base(pt, u1);
+    } else {
+        mul_double(pt, u1, u2, q);
+    }
+    if (pt.inf || u256_is_zero(pt.Z)) return false;
+    // x(pt) mod n == r ?  Avoid inversion: X == r' * Z^2 for r' in
+    // {r, r+n} (candidates < p).
+    U256 z2;
+    fe_sqr(z2, pt.Z);
+    U256 cand = r;  // r < n < p
+    for (int pass = 0; pass < 2; pass++) {
+        U256 rhs;
+        fe_mul(rhs, cand, z2);
+        if (u256_eq(rhs, pt.X)) return true;
+        // cand += n; stop if it overflows past p
+        U256 next;
+        u64 c = u256_add(next, cand, NORD);
+        if (c || u256_cmp(next, P) >= 0) break;
+        cand = next;
+    }
+    return false;
+}
+
+// RFC 6979 nonce (qlen = 256, HMAC-SHA256), matching
+// babble_tpu/crypto/secp256k1.py::rfc6979_k
+static void rfc6979_k(U256 &kout, const u8 priv[32], const u8 msg[32]) {
+    U256 h1;
+    u256_from_be(h1, msg);
+    sc_reduce_once(h1);
+    u8 h1b[32];
+    u256_to_be(h1b, h1);
+    u8 v[32], k[32];
+    memset(v, 0x01, 32);
+    memset(k, 0x00, 32);
+    u8 zero = 0x00, one = 0x01;
+    hmac_sha256(k, 32, v, 32, &zero, 1, priv, 32, h1b, 32, k);
+    hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+    hmac_sha256(k, 32, v, 32, &one, 1, priv, 32, h1b, 32, k);
+    hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+    for (;;) {
+        hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+        U256 cand;
+        u256_from_be(cand, v);
+        if (!u256_is_zero(cand) && u256_cmp(cand, NORD) < 0) {
+            kout = cand;
+            return;
+        }
+        hmac_sha256(k, 32, v, 32, &zero, 1, nullptr, 0, nullptr, 0, k);
+        hmac_sha256(k, 32, v, 32, nullptr, 0, nullptr, 0, nullptr, 0, v);
+    }
+}
+
+static int sign_one(const u8 priv[32], const u8 msg_in[32], u8 rs_out[64]) {
+    U256 d;
+    u256_from_be(d, priv);
+    if (u256_is_zero(d) || u256_cmp(d, NORD) >= 0) return 1;
+    u8 msg[32];
+    memcpy(msg, msg_in, 32);
+    U256 e;
+    u256_from_be(e, msg_in);
+    U256 em;
+    sc_from_u256(em, e);
+    for (int tries = 0; tries < 64; tries++) {
+        U256 k;
+        rfc6979_k(k, priv, msg);
+        Jac R;
+        mul_base(R, k);
+        Aff ra;
+        jac_to_aff(ra, R);
+        U256 r;
+        sc_from_u256(r, ra.x);
+        if (u256_is_zero(r)) {
+            sha256(msg, 32, msg);  // rehash-and-retry, mirroring the oracle
+            continue;
+        }
+        U256 kinv, rd, sum, s;
+        sc_inv(kinv, k);
+        sc_mul(rd, r, d);
+        sc_add(sum, em, rd);
+        sc_mul(s, kinv, sum);
+        if (u256_is_zero(s)) {
+            sha256(msg, 32, msg);
+            continue;
+        }
+        u256_to_be(rs_out, r);
+        u256_to_be(rs_out + 32, s);
+        return 0;
+    }
+    return 2;
+}
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int bt_has_native(void) { return 1; }
+
+// pub: n*64 bytes (x||y big-endian), msg: n*32, rs: n*64 (r||s), out: n bytes
+void bt_verify_batch(const u8 *pub, const u8 *msg, const u8 *rs, int n,
+                     u8 *out) {
+    for (int i = 0; i < n; i++)
+        out[i] = verify_one(pub + 64 * i, msg + 32 * i, rs + 64 * i) ? 1 : 0;
+}
+
+// returns 0 on success, nonzero on bad private key
+int bt_sign(const u8 *priv, const u8 *msg, u8 *rs_out) {
+    return sign_one(priv, msg, rs_out);
+}
+
+// out: 64 bytes x||y; returns 0 on success
+int bt_pubkey(const u8 *priv, u8 *out) {
+    U256 d;
+    u256_from_be(d, priv);
+    if (u256_is_zero(d) || u256_cmp(d, NORD) >= 0) return 1;
+    Jac R;
+    mul_base(R, d);
+    Aff a;
+    jac_to_aff(a, R);
+    u256_to_be(out, a.x);
+    u256_to_be(out + 32, a.y);
+    return 0;
+}
+
+// batch SHA-256: n messages, each len bytes (fixed stride), out n*32
+void bt_sha256_batch(const u8 *data, int stride, int n, u8 *out) {
+    for (int i = 0; i < n; i++) sha256(data + (long)i * stride, stride, out + 32 * i);
+}
+}

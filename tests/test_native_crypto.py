@@ -1,0 +1,124 @@
+"""Differential tests for the native C++ batch crypto library
+(native/secp256k1.cc via babble_tpu.native_crypto) against the pure-Python
+oracle (babble_tpu/crypto/secp256k1.py).
+
+The native signer must be BIT-IDENTICAL to the oracle (both implement
+RFC 6979 deterministic nonces without low-s normalization, matching the
+reference's Go crypto/ecdsa usage — keys/signature.go:13-18), and the
+verifier must agree on valid, corrupted, and adversarial inputs.
+"""
+
+from __future__ import annotations
+
+import random
+import secrets
+
+import pytest
+
+from babble_tpu import native_crypto as nc
+from babble_tpu.crypto import secp256k1 as curve
+from babble_tpu.crypto.batch import prevalidate_events_host
+from babble_tpu.crypto.hashing import sha256
+
+pytestmark = pytest.mark.skipif(
+    not nc.available(), reason="native crypto library unavailable"
+)
+
+
+def test_sign_verify_pubkey_differential():
+    rng = random.Random(1234)
+    for i in range(25):
+        d = rng.randrange(1, curve.N)
+        priv = d.to_bytes(32, "big")
+        msg = sha256(f"diff {i}".encode())
+
+        px, py = curve.pubkey_from_scalar(d)
+        assert nc.pubkey(priv) == (px, py)
+
+        r_py, s_py = curve.sign(d, msg)
+        assert nc.sign(priv, msg) == (r_py, s_py), "RFC6979 sig diverged"
+
+        pub64 = px.to_bytes(32, "big") + py.to_bytes(32, "big")
+        assert nc.verify_one(pub64, msg, r_py, s_py) is True
+        assert curve.verify((px, py), msg, r_py, s_py) is True
+        assert nc.verify_one(pub64, sha256(b"other"), r_py, s_py) is False
+        assert nc.verify_one(pub64, msg, r_py, (s_py + 1) % curve.N) is False
+
+
+def test_adversarial_inputs_rejected():
+    rng = random.Random(99)
+    d = rng.randrange(1, curve.N)
+    px, py = curve.pubkey_from_scalar(d)
+    pub64 = px.to_bytes(32, "big") + py.to_bytes(32, "big")
+    msg = sha256(b"adv")
+    r, s = curve.sign(d, msg)
+
+    assert nc.verify_one(pub64, msg, 0, s) is False
+    assert nc.verify_one(pub64, msg, r, 0) is False
+    assert nc.verify_one(pub64, msg, curve.N, s) is False
+    assert nc.verify_one(pub64, msg, r, curve.N + 5) is False
+    # base-36 decode is unbounded: negative and >256-bit values must be
+    # invalid, never an exception (remote events carry these)
+    assert nc.verify_one(pub64, msg, -1, s) is False
+    assert nc.verify_one(pub64, msg, r, -s) is False
+    assert nc.verify_one(pub64, msg, 1 << 300, s) is False
+    assert nc.verify_one(pub64, msg, r, 1 << 256) is False
+    off_curve = (px + 1).to_bytes(32, "big") + py.to_bytes(32, "big")
+    assert nc.verify_one(off_curve, msg, r, s) is False
+
+
+def test_hostile_signature_string_via_public_api():
+    """A gossiped event with signature '-1|1' must verify False end-to-end,
+    not crash the insert path."""
+    from babble_tpu.crypto.keys import PublicKey, generate_key
+
+    k = generate_key()
+    pk = k.public_key
+    msg = sha256(b"hostile")
+    assert pk.verify(msg, "-1|1") is False
+    assert pk.verify(msg, f"{1 << 300}|{7}") is False
+
+
+def test_batch_verify_mixed_validity():
+    rng = random.Random(5)
+    pubs, msgs, rss, expect = [], [], [], []
+    for i in range(40):
+        d = rng.randrange(1, curve.N)
+        px, py = curve.pubkey_from_scalar(d)
+        msg = sha256(f"batch {i}".encode())
+        r, s = curve.sign(d, msg)
+        good = i % 3 != 0
+        if not good:
+            s = (s + 1) % curve.N or 1
+        pubs.append(px.to_bytes(32, "big") + py.to_bytes(32, "big"))
+        msgs.append(msg)
+        rss.append((r, s))
+        expect.append(good)
+    assert nc.verify_batch(pubs, msgs, rss) == expect
+
+
+def test_sha256_batch_differential():
+    msgs = [secrets.token_bytes(120) for _ in range(50)]
+    assert nc.sha256_batch(msgs) == [sha256(m) for m in msgs]
+
+
+def test_prevalidate_events_host():
+    """End-to-end over real Events: a tampered event fails, others pass,
+    and the insert-path verify() consumes the cached verdicts."""
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.hashgraph.event import Event
+
+    k = generate_key()
+    events = []
+    for i in range(6):
+        ev = Event.new([f"tx{i}".encode()], [], [], ["", ""], k.public_key.bytes(), i, timestamp=i)
+        ev.sign(k)
+        events.append(ev)
+    # tamper with one signature
+    bad = events[3]
+    sig = bad.signature
+    bad.signature = sig[:-2] + ("0" if sig[-1] != "0" else "1") + sig[-1]
+
+    assert prevalidate_events_host(events) is True
+    for i, ev in enumerate(events):
+        assert ev.verify() is (i != 3)
