@@ -47,6 +47,8 @@ _RUN_FLAGS = {
     "maintenance_mode": ("maintenance_mode", bool),
     "moniker": ("moniker", str),
     "accelerator": ("accelerator", bool),
+    "signal": ("signal", bool),
+    "signal_addr": ("signal_addr", str),
 }
 
 
@@ -120,6 +122,29 @@ def cmd_run(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_signal(args: argparse.Namespace) -> int:
+    """Standalone signal/relay server daemon (reference: cmd/signal)."""
+    import time as _time
+
+    from ..net.signal import SignalServer
+
+    server = SignalServer(args.listen)
+    addr = server.listen()
+    print(f"signal server listening on {addr}")
+
+    stop = {"flag": False}
+
+    def _stop(signum, frame):
+        stop["flag"] = True
+        server.close()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    while not stop["flag"]:
+        _time.sleep(0.2)
+    return 0
+
+
 def cmd_version(_: argparse.Namespace) -> int:
     print(VERSION)
     return 0
@@ -157,6 +182,14 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--moniker", default=None)
     run.add_argument("--accelerator", action="store_true")
     run.add_argument(
+        "--signal", action="store_true",
+        help="relay mode: route gossip via a signal server, addressed by pubkey",
+    )
+    run.add_argument(
+        "--signal-addr", dest="signal_addr", default=None,
+        help="signal/relay server host:port (default 127.0.0.1:2443)",
+    )
+    run.add_argument(
         "--proxy-listen", dest="proxy_listen", default="127.0.0.1:1338",
         help="where Babble serves SubmitTx for the app",
     )
@@ -169,6 +202,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the built-in dummy app in-process instead of the socket proxy",
     )
     run.set_defaults(fn=cmd_run)
+
+    sig = sub.add_parser(
+        "signal", help="run a standalone signal/relay server"
+    )
+    sig.add_argument(
+        "--listen", default="0.0.0.0:2443", help="bind host:port"
+    )
+    sig.set_defaults(fn=cmd_signal)
 
     ver = sub.add_parser("version", help="print the version")
     ver.set_defaults(fn=cmd_version)

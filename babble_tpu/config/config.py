@@ -45,6 +45,13 @@ class Config:
     sync_limit: int = 1000
     suspend_limit: int = 100
 
+    # Signal/relay mode (the reference's WebRTC+WAMP analogue,
+    # config/config.go:163-187): nodes keep one outbound connection to a
+    # rendezvous server and are addressed by public key, so NAT-ed nodes
+    # can participate without accepting inbound connections.
+    signal: bool = False
+    signal_addr: str = "127.0.0.1:2443"
+
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory
     database_dir: str = ""

@@ -84,16 +84,28 @@ class Babble:
         self.store = PersistentStore(self.config.cache_size, db_path)
 
     def init_transport(self) -> None:
-        """reference: babble.go:165-218 (TCP branch; the reference's
-        WebRTC+WAMP branch is a deliberate non-goal on this stack — the
-        Transport protocol is the extension point)."""
-        self.transport = TCPTransport(
-            self.config.bind_addr,
-            advertise_addr=self.config.advertise_addr or None,
-            max_pool=self.config.max_pool,
-            timeout=self.config.tcp_timeout,
-            join_timeout=self.config.join_timeout,
-        )
+        """reference: babble.go:165-218. TCP by default; with --signal the
+        node instead keeps one outbound connection to a relay server and is
+        addressed by its public key (the WebRTC+WAMP analogue — in signal
+        mode peers.json NetAddr entries carry pubkeys, not host:port)."""
+        if self.config.signal:
+            from .net.signal import SignalTransport
+
+            assert self.key is not None
+            self.transport = SignalTransport(
+                self.config.signal_addr,
+                self.key,
+                timeout=self.config.tcp_timeout,
+                join_timeout=self.config.join_timeout,
+            )
+        else:
+            self.transport = TCPTransport(
+                self.config.bind_addr,
+                advertise_addr=self.config.advertise_addr or None,
+                max_pool=self.config.max_pool,
+                timeout=self.config.tcp_timeout,
+                join_timeout=self.config.join_timeout,
+            )
         self.transport.listen()
 
     def init_node(self) -> None:

@@ -34,7 +34,14 @@ from .rpc import (
 from .transport import TransportError
 
 
+# Upper bound on any frame (request or response). A hostile peer could
+# otherwise send a 4 GB length prefix and make the receiver allocate it.
+MAX_FRAME = 64 * 1024 * 1024
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame of {n} bytes exceeds limit")
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))

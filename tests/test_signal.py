@@ -1,0 +1,190 @@
+"""Signal/relay transport tests — the WebRTC+WAMP analogue
+(reference: src/net/webrtc_stream_layer_test.go:12, signal/wamp/wamp_test.go:18,
+and TestWebRTCGossip node_test.go:120): RPC round-trips through the relay
+server, then a full 3-node gossip where every node only dials OUT (as a
+NAT-ed node would) and is addressed purely by public key."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.rpc import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from babble_tpu.net.signal import SignalServer, SignalTransport
+from babble_tpu.net.transport import TransportError
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+from test_node import bombard_and_wait, check_gossip, shutdown_all
+
+
+@pytest.fixture
+def server():
+    srv = SignalServer("127.0.0.1:0")
+    srv.listen()
+    yield srv
+    srv.close()
+
+
+def _responder(trans, stop: threading.Event):
+    def run():
+        while not stop.is_set():
+            try:
+                rpc = trans.consumer().get(timeout=0.1)
+            except Exception:
+                continue
+            cmd = rpc.command
+            if isinstance(cmd, SyncRequest):
+                rpc.respond(SyncResponse(from_id=42, known={1: 2}), None)
+            elif isinstance(cmd, EagerSyncRequest):
+                rpc.respond(EagerSyncResponse(42, True), None)
+            else:
+                rpc.respond(None, "unsupported in test")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_rpc_roundtrip_via_relay(server):
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka)
+    tb = SignalTransport(server.addr(), kb)
+    ta.listen()
+    tb.listen()
+    stop = threading.Event()
+    _responder(tb, stop)
+    try:
+        resp = ta.sync(
+            kb.public_key.hex(), SyncRequest(7, {0: 1}, 100)
+        )
+        assert resp.from_id == 42 and resp.known == {1: 2}
+        resp2 = ta.eager_sync(kb.public_key.hex(), EagerSyncRequest(7, []))
+        assert resp2.success is True
+        # unknown peer -> remote error from the server
+        with pytest.raises(TransportError):
+            ta.sync("ff" * 65, SyncRequest(7, {}, 10))
+    finally:
+        stop.set()
+        ta.close()
+        tb.close()
+
+
+def test_gossip_three_nodes_over_relay(server):
+    """checkGossip oracle over the relay: blocks byte-identical while no
+    node ever accepts an inbound connection."""
+    keys = [generate_key() for _ in range(3)]
+    # in signal mode NetAddr carries the pubkey, not host:port
+    peers = PeerSet(
+        [
+            Peer(k.public_key.hex(), k.public_key.hex(), f"sig{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    nodes, proxies = [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.02,
+            slow_heartbeat_timeout=0.2,
+            log_level="warning",
+            moniker=f"sig{i}",
+        )
+        trans = SignalTransport(server.addr(), k)
+        pr = InmemProxy(DummyState())
+        node = Node(
+            conf,
+            Validator(k, f"sig{i}"),
+            peers,
+            peers,
+            InmemStore(conf.cache_size),
+            trans,
+            pr,
+        )
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=2, timeout=60.0)
+        check_gossip(nodes, 0, 2)
+    finally:
+        shutdown_all(nodes)
+
+
+def test_unauthenticated_registration_rejected(server):
+    """Claiming a pubkey without its private key must not register: the
+    server challenges and verifies a signature, so identities cannot be
+    hijacked by a bare {register: <victim pubkey>} frame."""
+    import json as _json
+    import socket as _socket
+    import struct as _struct
+
+    victim = generate_key()
+    tv = SignalTransport(server.addr(), victim)
+    tv.listen()
+    stop = threading.Event()
+    _responder(tv, stop)
+
+    host, port_s = server.addr().rsplit(":", 1)
+    raw = _socket.create_connection((host, int(port_s)), timeout=5)
+    raw.settimeout(5)
+    # read the challenge, answer WITHOUT a valid signature
+    (ln,) = _struct.unpack(">I", raw.recv(4))
+    raw.recv(ln)
+    payload = _json.dumps(
+        {"register": victim.public_key.hex()[2:].lower(), "sig": "1|1"}
+    ).encode()
+    raw.sendall(_struct.pack(">I", len(payload)) + payload)
+    # server must drop the impostor...
+    assert raw.recv(1) == b"", "impostor connection not closed"
+    # ...and the victim must still be routable
+    other = generate_key()
+    to = SignalTransport(server.addr(), other)
+    to.listen()
+    resp = to.sync(victim.public_key.hex(), SyncRequest(1, {}, 10))
+    assert resp.from_id == 42
+    stop.set()
+    tv.close()
+    to.close()
+    raw.close()
+
+
+def test_reconnecting_client_replaces_registration(server):
+    """A client re-registering under the same pubkey takes over routing
+    (the reference renegotiates the peer connection the same way)."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka)
+    ta.listen()
+    tb1 = SignalTransport(server.addr(), kb)
+    tb1.listen()
+    stop1 = threading.Event()
+    _responder(tb1, stop1)
+    resp = ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 10))
+    assert resp.from_id == 42
+    # second client with the same key replaces the first
+    tb2 = SignalTransport(server.addr(), kb)
+    tb2.listen()
+    stop2 = threading.Event()
+    _responder(tb2, stop2)
+    time.sleep(0.2)
+    resp = ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 10))
+    assert resp.from_id == 42
+    stop1.set()
+    stop2.set()
+    for t in (ta, tb1, tb2):
+        t.close()
