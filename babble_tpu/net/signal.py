@@ -46,7 +46,10 @@ logger = logging.getLogger(__name__)
 
 def _recv_frame(sock: socket.socket) -> dict:
     (length,) = struct.unpack(">I", _recv_exact(sock, 4))
-    return json.loads(_recv_exact(sock, length))
+    frame = json.loads(_recv_exact(sock, length))
+    if not isinstance(frame, dict):
+        raise ValueError("frame is not an object")
+    return frame
 
 
 def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
@@ -128,6 +131,12 @@ class SignalServer:
                 old = self._clients.get(pub)
                 self._clients[pub] = (conn, wlock)
             if old is not None:
+                # tell the displaced client it was replaced (not a server
+                # crash) so it backs off instead of kicking back instantly
+                try:
+                    _send_frame(old[0], {"kind": "displaced"}, old[1])
+                except (OSError, ConnectionError):
+                    pass
                 try:
                     old[0].close()
                 except OSError:
@@ -270,11 +279,18 @@ class SignalTransport:
             sock = self._sock
             if sock is None:
                 return
+            displaced = False
             try:
                 while not self._shutdown.is_set():
                     frame = _recv_frame(sock)
-                    backoff = 0.2
                     kind = frame.get("kind")
+                    if kind == "displaced":
+                        # another live client took over this key; back off
+                        # hard so two same-key processes don't livelock
+                        # kicking each other
+                        displaced = True
+                        continue
+                    backoff = 0.2
                     if kind == "resp":
                         with self._plock:
                             entry = self._pending.get(frame.get("ch"))
@@ -296,6 +312,8 @@ class SignalTransport:
                 pass
             # relay connection dropped: reconnect with backoff so a signal
             # server restart does not permanently silence the node
+            if displaced:
+                time.sleep(5.0)
             while not self._shutdown.is_set():
                 try:
                     self._sock = self._connect()

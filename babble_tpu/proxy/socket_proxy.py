@@ -26,14 +26,8 @@ from ..hashgraph.internal_transaction import InternalTransactionReceipt
 from .proxy import CommitResponse, ProxyHandler
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("connection closed")
-        buf += chunk
-    return buf
+# Shared length-prefixed framing, including the hostile-length-prefix cap.
+from ..net.tcp import _recv_exact  # noqa: E402
 
 
 def _send_msg(sock: socket.socket, obj) -> None:

@@ -126,7 +126,275 @@ def bench_dag_pipeline(n_peers: int = 16, n_events: int = 512, reps: int = 10):
     return n_events / dt, dt, str(jax.devices()[0])
 
 
+def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02):
+    """Full nodes over localhost TCP (BASELINE.md config 3 topology)."""
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+    from babble_tpu.proxy.proxy import InmemProxy
+
+    keys = [generate_key() for _ in range(n_nodes)]
+    peers = PeerSet(
+        [
+            Peer(f"127.0.0.1:{base_port + i}", k.public_key.hex(), f"t{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=heartbeat,
+            slow_heartbeat_timeout=0.3,
+            log_level="error",
+            moniker=f"t{i}",
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        trans = TCPTransport(addr[k.public_key.hex()], timeout=2.0)
+        node = Node(conf, Validator(k, f"t{i}"), peers, peers,
+                    InmemStore(conf.cache_size), trans, pr)
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    for node in nodes:
+        node.run_async()
+    return nodes, proxies, states
+
+
+def _measure_rate(submit, committed, window_s: float, warmup_s: float = 3.0):
+    """Committed tx/s over a wall-clock window under continuous load.
+    ``submit(i)`` sends one transaction; ``committed()`` reports progress."""
+    i = 0
+    t_end = time.monotonic() + warmup_s
+    while time.monotonic() < t_end:
+        submit(i)
+        i += 1
+        time.sleep(0.003)
+    base = committed()
+    t0 = time.monotonic()
+    t_end = t0 + window_s
+    while time.monotonic() < t_end:
+        submit(i)
+        i += 1
+        time.sleep(0.003)
+    elapsed = time.monotonic() - t0
+    return (committed() - base) / elapsed
+
+
+def _measure(nodes, proxies, states, window_s: float, warmup_s: float = 3.0):
+    """Committed tx/s (min across nodes) over a wall-clock window."""
+    return _measure_rate(
+        lambda i: proxies[i % len(proxies)].submit_tx(f"tx{i}".encode()),
+        lambda: min(len(s.committed_txs) for s in states),
+        window_s,
+        warmup_s,
+    )
+
+
+def bench_socket_proxy(window_s: float = 10.0):
+    """Config 2: 2-node cluster where one app attaches over the JSON-RPC
+    socket pair (SubmitTx + State.CommitBlock cross a process-style
+    boundary, reference: src/proxy/socket)."""
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.dummy.socket_client import DummySocketClient
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.inmem import InmemNetwork
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+    from babble_tpu.proxy.proxy import InmemProxy
+    from babble_tpu.proxy.socket_proxy import SocketAppProxy
+
+    net = InmemNetwork()
+    keys = [generate_key() for _ in range(2)]
+    peers = PeerSet(
+        [Peer(f"inmem://s{i}", k.public_key.hex(), f"s{i}")
+         for i, k in enumerate(keys)]
+    )
+    addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    sock_proxy = SocketAppProxy("127.0.0.1:27010", "127.0.0.1:27011")
+    client = DummySocketClient("127.0.0.1:27011", "127.0.0.1:27010")
+    nodes = []
+    inmem_state = DummyState()
+    for i, k in enumerate(keys):
+        conf = Config(heartbeat_timeout=0.02, slow_heartbeat_timeout=0.3,
+                      log_level="error", moniker=f"s{i}")
+        proxy = sock_proxy if i == 0 else InmemProxy(inmem_state)
+        node = Node(conf, Validator(k, f"s{i}"), peers, peers,
+                    InmemStore(conf.cache_size), net.new_transport(addr[k.public_key.hex()]), proxy)
+        node.init()
+        nodes.append(node)
+    try:
+        for n in nodes:
+            n.run_async()
+        return _measure_rate(
+            lambda i: client.submit_tx(f"sock tx {i}".encode()),
+            lambda: len(client.state.committed_txs),
+            window_s,
+        )
+    finally:
+        for n in nodes:
+            n.shutdown()
+        client.close()
+
+
+def bench_16node_tcp(window_s: float = 15.0):
+    """Config 3: 16 full nodes over localhost TCP."""
+    nodes, proxies, states = _make_tcp_cluster(16, 28100, heartbeat=0.05)
+    try:
+        return _measure(nodes, proxies, states, window_s, warmup_s=8.0)
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+def bench_churn(window_s: float = 20.0):
+    """Config 4: 4-node TCP cluster with a node joining and leaving under
+    load (dynamic membership churn)."""
+    import threading
+
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.proxy.proxy import InmemProxy
+
+    nodes, proxies, states = _make_tcp_cluster(4, 28300, heartbeat=0.02)
+    stop = threading.Event()
+    churn_counts = {"joins": 0, "leaves": 0}
+
+    def churner():
+        while not stop.is_set():
+            k = generate_key()
+            conf = Config(heartbeat_timeout=0.02, slow_heartbeat_timeout=0.3,
+                          log_level="error", moniker="churn",
+                          join_timeout=20.0)
+            trans = TCPTransport("127.0.0.1:0", timeout=2.0,
+                                 join_timeout=20.0)
+            node = Node(conf, Validator(k, "churn"),
+                        nodes[0].core.peers, nodes[0].core.genesis_peers,
+                        InmemStore(conf.cache_size), trans, InmemProxy(DummyState()))
+            node.init()
+            node.run_async()
+            from babble_tpu.node.state import State as NState
+            deadline = time.monotonic() + 25.0
+            while (node.get_state() != NState.BABBLING
+                   and time.monotonic() < deadline and not stop.is_set()):
+                time.sleep(0.1)
+            if node.get_state() == NState.BABBLING:
+                churn_counts["joins"] += 1
+                time.sleep(2.0)
+                try:
+                    node.leave()
+                    churn_counts["leaves"] += 1
+                except Exception:
+                    node.shutdown()
+            else:
+                node.shutdown()
+
+    t = threading.Thread(target=churner, daemon=True)
+    t.start()
+    try:
+        rate = _measure(nodes, proxies, states, window_s, warmup_s=3.0)
+    finally:
+        stop.set()
+        for n in nodes:
+            n.shutdown()
+    return rate, churn_counts
+
+
+def bench_adversarial(window_s: float = 10.0):
+    """Config 5: 4 honest nodes + a Byzantine client flooding EagerSync
+    pushes of events with bad signatures; honest throughput must hold and
+    every junk event must be rejected."""
+    import threading
+
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.hashgraph.event import Event
+    from babble_tpu.net.rpc import EagerSyncRequest
+    from babble_tpu.net.tcp import TCPTransport
+
+    nodes, proxies, states = _make_tcp_cluster(4, 28500, heartbeat=0.02)
+    stop = threading.Event()
+    flood = {"sent": 0}
+
+    def flooder():
+        rogue_key = generate_key()
+        trans = TCPTransport("127.0.0.1:28590", timeout=2.0)
+        targets = [p.net_addr for p in nodes[0].core.peers.peers]
+        seq = 0
+        while not stop.is_set():
+            evs = []
+            for _ in range(20):
+                ev = Event.new([b"junk"], [], [], ["", ""],
+                               rogue_key.public_key.bytes(), seq, timestamp=seq)
+                ev.signature = "1|1"  # invalid signature
+                evs.append(ev.to_wire())
+                seq += 1
+            try:
+                trans.eager_sync(targets[seq % len(targets)],
+                                 EagerSyncRequest(999, evs))
+            except Exception:
+                pass
+            flood["sent"] += len(evs)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=flooder, daemon=True)
+    t.start()
+    try:
+        rate = _measure(nodes, proxies, states, window_s, warmup_s=3.0)
+        junk_accepted = sum(
+            1 for n in nodes
+            for h in n.core.hg.undetermined_events
+            if b"junk" in (n.core.hg.store.get_event(h).body.transactions or [b""])[0]
+        )
+    finally:
+        stop.set()
+        for n in nodes:
+            n.shutdown()
+    return rate, flood["sent"], junk_accepted
+
+
+def main_all() -> None:
+    """Extended run filling BASELINE.md configs 2-5 (invoke: bench.py --all)."""
+    out = {}
+    rate2 = bench_socket_proxy()
+    out["config2_socket_proxy_txs_per_s"] = round(rate2, 1)
+    print(f"config 2 (socket proxy, 2 nodes): {rate2:.1f} tx/s", file=sys.stderr)
+    rate3 = bench_16node_tcp()
+    out["config3_16node_tcp_txs_per_s"] = round(rate3, 1)
+    print(f"config 3 (16-node TCP): {rate3:.1f} tx/s", file=sys.stderr)
+    rate4, churn = bench_churn()
+    out["config4_churn_txs_per_s"] = round(rate4, 1)
+    out["config4_churn_events"] = churn
+    print(f"config 4 (churn): {rate4:.1f} tx/s, {churn}", file=sys.stderr)
+    rate5, flooded, junk = bench_adversarial()
+    out["config5_adversarial_txs_per_s"] = round(rate5, 1)
+    out["config5_bad_sigs_flooded"] = flooded
+    out["config5_junk_accepted"] = junk
+    print(f"config 5 (bad-sig flood): {rate5:.1f} tx/s honest, "
+          f"{flooded} junk sent, {junk} accepted", file=sys.stderr)
+    print(json.dumps(out))
+
+
 def main() -> None:
+    if "--all" in sys.argv:
+        return main_all()
     txs_per_s, committed, blocks, elapsed = bench_gossip()
     dag_events_per_s, dag_dt, device = bench_dag_pipeline()
 
