@@ -145,6 +145,66 @@ def cmd_signal(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_dummy(args: argparse.Namespace) -> int:
+    """Interactive dummy chat-app client over the socket proxy pair
+    (reference: cmd/dummy/commands/root.go:33-60). Lines typed on stdin
+    are submitted as transactions; committed blocks print as they land.
+    With --no-repl it serves commits silently (for scripted testnets)."""
+    import time as _time
+
+    from ..dummy.socket_client import DummySocketClient
+
+    client = DummySocketClient(args.listen, args.connect)
+    print(f"dummy app serving on {args.listen}, submitting to {args.connect}")
+
+    orig_commit = client.state.commit_handler
+
+    def loud_commit(block):
+        resp = orig_commit(block)
+        for tx in block.transactions():
+            print(f"[block {block.index()}] {tx.decode(errors='replace')}")
+        return resp
+
+    if not args.no_repl:
+        client.state.commit_handler = loud_commit
+
+    stop = {"flag": False}
+
+    def _stop(signum, frame):
+        stop["flag"] = True
+        if signum == signal.SIGINT:
+            # let the blocking readline() in the REPL unwind via
+            # KeyboardInterrupt instead of resuming on EINTR (PEP 475)
+            raise KeyboardInterrupt
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+
+    try:
+        if args.no_repl:
+            while not stop["flag"]:
+                _time.sleep(0.2)
+        else:
+            while not stop["flag"]:
+                line = sys.stdin.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    client.submit_tx(line.encode())
+                except Exception as err:
+                    # a dropped tx is recoverable; keep the chat alive
+                    print(f"submit failed ({err}); is the node up?",
+                          file=sys.stderr)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    return 0
+
+
 def cmd_version(_: argparse.Namespace) -> int:
     print(VERSION)
     return 0
@@ -202,6 +262,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the built-in dummy app in-process instead of the socket proxy",
     )
     run.set_defaults(fn=cmd_run)
+
+    dmy = sub.add_parser(
+        "dummy", help="interactive dummy chat app over the socket proxy"
+    )
+    dmy.add_argument(
+        "--listen", default="127.0.0.1:1339", help="app-side bind host:port"
+    )
+    dmy.add_argument(
+        "--connect", default="127.0.0.1:1338",
+        help="babble-side proxy host:port",
+    )
+    dmy.add_argument(
+        "--no-repl", dest="no_repl", action="store_true",
+        help="serve commits without the stdin chat loop",
+    )
+    dmy.set_defaults(fn=cmd_dummy)
 
     sig = sub.add_parser(
         "signal", help="run a standalone signal/relay server"

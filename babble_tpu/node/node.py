@@ -39,6 +39,7 @@ from ..net.rpc import (
 from ..net.transport import Transport, TransportError
 from ..peers.peer import Peer
 from ..peers.peer_set import PeerSet
+from ..common.latency import LatencyRecorder
 from ..proxy.proxy import AppProxy
 from .control_timer import ControlTimer
 from .core import Core
@@ -84,6 +85,9 @@ class Node(StateManager):
         self.start_time = 0.0
         self.sync_requests = 0
         self.sync_errors = 0
+        # Gossip-leg durations, served at /debug/timers (the reference logs
+        # the same ns durations per round, node.go:511-514,543-548,593-608).
+        self.timers = LatencyRecorder()
         self.initial_undetermined_events = 0
         # Cap overlapping gossip rounds: unbounded overlap just piles
         # threads onto core_lock under the GIL (the Go reference relies on
@@ -333,21 +337,29 @@ class Node(StateManager):
         """SyncRequest leg (reference: node.go:504-538)."""
         with self.core_lock:
             known = self.core.known_events()
+        t0 = time.monotonic()
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
+        self.timers.record("request_sync", time.monotonic() - t0)
+        t0 = time.monotonic()
         with self.core_lock:
             self._sync(peer.id, resp.events)
+        self.timers.record("sync", time.monotonic() - t0)
         return resp.known
 
     def _push(self, peer: Peer, known_events: Dict[int, int]) -> None:
         """EagerSyncRequest leg (reference: node.go:541-587)."""
+        t0 = time.monotonic()
         with self.core_lock:
             diff = self.core.event_diff(known_events)
+        self.timers.record("diff", time.monotonic() - t0)
         if not diff:
             return
         if len(diff) > self.conf.sync_limit:
             diff = diff[: self.conf.sync_limit]
         wire = self.core.to_wire(diff)
+        t0 = time.monotonic()
         self._request_eager_sync(peer.net_addr, wire)
+        self.timers.record("eager_sync", time.monotonic() - t0)
 
     def _sync(self, from_id: int, events: List[WireEvent]) -> None:
         """Insert events + process the sig pool; callers hold core_lock
@@ -357,7 +369,9 @@ class Node(StateManager):
         except Exception as err:
             if not is_normal_self_parent_error(err):
                 raise
+        t0 = time.monotonic()
         self.core.process_sig_pool()
+        self.timers.record("process_sig_pool", time.monotonic() - t0)
 
     # -- catching up --------------------------------------------------------
 

@@ -100,6 +100,14 @@ class Service:
                         for r, ps in self.node.get_all_validator_sets().items()
                     }
                 )
+            elif path == "/debug/timers":
+                # gossip-leg latency percentiles (the pprof analogue of the
+                # reference's ad-hoc ns duration logs, node.go:511-514)
+                body = self.node.timers.snapshot()
+            elif path == "/debug/stacks":
+                body = self._thread_stacks()
+            elif path == "/debug/profile":
+                body = self._jax_profile(parse_qs(parsed.query))
             else:
                 self._send(req, 404, {"error": f"no route {path}"})
                 return
@@ -123,6 +131,51 @@ class Service:
         for i in range(start, min(start + count, last + 1)):
             out.append(_jsonable(self.node.get_block(i).to_dict()))
         return out
+
+    @staticmethod
+    def _thread_stacks():
+        """All live thread stacks — the /debug/pprof/goroutine analogue."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        return {
+            f"{names.get(tid, '?')} ({tid})": traceback.format_stack(frame)
+            for tid, frame in sys._current_frames().items()
+        }
+
+    _profile_lock = threading.Lock()
+
+    @classmethod
+    def _jax_profile(cls, qs) -> dict:
+        """Capture a JAX device trace for ?seconds=N (default 3) into
+        /tmp/babble_tpu_profile; view with TensorBoard or xprof."""
+        import math
+        import time as _time
+
+        try:
+            import jax
+        except Exception as err:  # pragma: no cover
+            return {"error": f"jax unavailable: {err}"}
+        try:
+            seconds = float(qs.get("seconds", ["3"])[0])
+        except ValueError:
+            seconds = 3.0
+        if not math.isfinite(seconds) or seconds <= 0:
+            seconds = 3.0
+        seconds = min(seconds, 30.0)
+        if not cls._profile_lock.acquire(blocking=False):
+            return {"error": "a profile capture is already running"}
+        out_dir = "/tmp/babble_tpu_profile"
+        try:
+            jax.profiler.start_trace(out_dir)
+            try:
+                _time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            cls._profile_lock.release()
+        return {"trace_dir": out_dir, "seconds": seconds}
 
     @staticmethod
     def _send(req: BaseHTTPRequestHandler, code: int, body) -> None:

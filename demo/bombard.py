@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Submit M transactions to each of N testnet nodes through their socket
+proxies (reference: /root/reference/demo/scripts/bombard.sh, which pushes
+JSON-RPC via netcat; here we speak the framed JSON-RPC directly).
+
+Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from babble_tpu.proxy.socket_proxy import JsonRpcClient  # noqa: E402
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if len(args) > 0 else 4
+    m = int(args[1]) if len(args) > 1 else 100
+    base_port = 13000
+    for a in sys.argv[1:]:
+        if a.startswith("--base-port"):
+            base_port = int(a.split("=", 1)[1])
+
+    sent = 0
+    for i in range(n):
+        client = JsonRpcClient(f"127.0.0.1:{base_port + i}")
+        for j in range(m):
+            tx = f"node{i} tx {j}".encode()
+            client.call(
+                "Babble.SubmitTx", base64.b64encode(tx).decode("ascii")
+            )
+            sent += 1
+        client.close()
+        print(f"node{i}: {m} txs submitted")
+    print(f"total: {sent}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Spin up an N-node localhost testnet (the demo/ makefile analogue,
+reference: /root/reference/demo/makefile + demo/scripts/*.sh, minus docker).
+
+Each node is a separate OS process running `babble_tpu run` with a socket
+app proxy; a dummy chat-app client process attaches to each. Ports:
+
+  node i:  gossip 127.0.0.1:12000+i   service 127.0.0.1:8000+i
+           proxy  127.0.0.1:13000+i   app     127.0.0.1:14000+i
+
+Usage:  python demo/testnet.py [n_nodes] [--signal]
+Stop with Ctrl-C (nodes leave politely on SIGTERM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from babble_tpu.crypto.keyfile import SimpleKeyfile  # noqa: E402
+from babble_tpu.crypto.keys import generate_key  # noqa: E402
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 4
+    use_signal = "--signal" in sys.argv
+    base = tempfile.mkdtemp(prefix="babble_tpu_testnet_")
+    print(f"testnet dir: {base}")
+
+    keys = [generate_key() for _ in range(n)]
+    peers = [
+        {
+            "NetAddr": (
+                k.public_key.hex() if use_signal else f"127.0.0.1:{12000 + i}"
+            ),
+            "PubKeyHex": k.public_key.hex(),
+            "Moniker": f"node{i}",
+        }
+        for i, k in enumerate(keys)
+    ]
+
+    procs: list[subprocess.Popen] = []
+    try:
+        if use_signal:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "babble_tpu.cli", "signal",
+                     "--listen", "127.0.0.1:2443"]
+                )
+            )
+            time.sleep(0.5)
+
+        for i, k in enumerate(keys):
+            dd = os.path.join(base, f"node{i}")
+            os.makedirs(dd)
+            SimpleKeyfile(os.path.join(dd, "priv_key")).write_key(k)
+            for fn in ("peers.json", "peers.genesis.json"):
+                with open(os.path.join(dd, fn), "w") as f:
+                    json.dump(peers, f, indent=2)
+            cmd = [
+                sys.executable, "-m", "babble_tpu.cli", "run",
+                "--datadir", dd,
+                "--listen", f"127.0.0.1:{12000 + i}",
+                "--service-listen", f"127.0.0.1:{8000 + i}",
+                "--proxy-listen", f"127.0.0.1:{13000 + i}",
+                "--client-connect", f"127.0.0.1:{14000 + i}",
+                "--heartbeat", "0.02", "--slow-heartbeat", "0.5",
+                "--moniker", f"node{i}", "--log", "info",
+            ]
+            if use_signal:
+                cmd += ["--signal", "--signal-addr", "127.0.0.1:2443"]
+            procs.append(subprocess.Popen(cmd))
+            # dummy chat-app client on the other side of the socket pair
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "babble_tpu.cli", "dummy",
+                     "--listen", f"127.0.0.1:{14000 + i}",
+                     "--connect", f"127.0.0.1:{13000 + i}",
+                     "--no-repl"]
+                )
+            )
+
+        print(f"{n} nodes up. Stats:    curl 127.0.0.1:800N/stats")
+        print("          Load:     python demo/bombard.py")
+        print("          Graph:    curl 127.0.0.1:8000/graph")
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        time.sleep(1)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

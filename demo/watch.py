@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Poll every testnet node's /stats once a second — the watcher container
+analogue (reference: /root/reference/docker/watcher/watch.sh).
+
+Usage:  python demo/watch.py [n_nodes] [--base-port 8000]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if len(args) > 0 else 4
+    base_port = 8000
+    for a in sys.argv[1:]:
+        if a.startswith("--base-port"):
+            base_port = int(a.split("=", 1)[1])
+    try:
+        while True:
+            row = []
+            for i in range(n):
+                try:
+                    d = json.loads(
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{base_port + i}/stats",
+                            timeout=2,
+                        ).read()
+                    )
+                    row.append(
+                        f"n{i}:[{d['state']} blk={d['last_block_index']} "
+                        f"rnd={d['last_consensus_round']} "
+                        f"txs={d['transactions']}]"
+                    )
+                except Exception:
+                    row.append(f"n{i}:[down]")
+            print("  ".join(row))
+            time.sleep(1)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
