@@ -164,14 +164,31 @@ class PublicKey:
         return PublicKey.from_bytes(bytes.fromhex(t))
 
 
+from functools import lru_cache as _pk_lru_cache
+
+
+@_pk_lru_cache(maxsize=1024)
+def _pubkey_of_scalar(d: int) -> "PublicKey":
+    """One scalar multiplication per key per process — Block.sign and
+    friends access .public_key on every signature."""
+    from babble_tpu import native_crypto
+
+    try:
+        xy = native_crypto.pubkey(d.to_bytes(32, "big"))
+    except Exception:
+        xy = None
+    if xy is None:
+        xy = curve.pubkey_from_scalar(d)
+    return PublicKey(*xy)
+
+
 @dataclass(frozen=True)
 class PrivateKey:
     d: int
 
     @property
     def public_key(self) -> PublicKey:
-        x, y = curve.pubkey_from_scalar(self.d)
-        return PublicKey(x, y)
+        return _pubkey_of_scalar(self.d)
 
     def sign(self, msg_hash: bytes) -> str:
         r, s = self.sign_rs(msg_hash)

@@ -50,10 +50,22 @@ class BlockBody:
             ],
         }
 
+    def __setattr__(self, name, value):
+        # Any body mutation (commit fills state_hash/receipts) invalidates
+        # the cached canonical hash.
+        object.__setattr__(self, name, value)
+        if name != "_hash_cache":
+            object.__setattr__(self, "_hash_cache", b"")
+
     def hash(self) -> bytes:
         """SHA256 of the canonical encoding — what validators sign
-        (reference: block.go:49-55)."""
-        return sha256(canonical_dumps(self.to_dict()))
+        (reference: block.go:49-55). Cached until a field changes: the sig
+        pool re-verifies against this hash once per gossiped signature."""
+        cached = getattr(self, "_hash_cache", b"")
+        if not cached:
+            cached = sha256(canonical_dumps(self.to_dict()))
+            object.__setattr__(self, "_hash_cache", cached)
+        return cached
 
     @staticmethod
     def from_dict(d: dict) -> "BlockBody":
