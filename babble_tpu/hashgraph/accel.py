@@ -1,0 +1,150 @@
+"""TensorConsensus — drives the device voting kernels for a live Hashgraph.
+
+Attached to a Hashgraph by the node's core when ``--accelerator`` is on.
+``Hashgraph.insert_event_and_run_consensus`` then defers DecideFame /
+DecideRoundReceived to batched device sweeps (the reference runs them per
+insert, hashgraph.go:644-668; here a sweep covers a whole sync batch so
+device dispatch amortizes across the gossip round — SURVEY.md hard-part 6).
+
+A sweep:
+1. snapshots the undecided window (``ops.voting.build_voting_window``),
+2. runs fame on device, applies it host-side with the oracle's sticky
+   round-decided bookkeeping,
+3. runs round-received on device with the host-stamped decided mask,
+4. leaves frame/block construction to the untouched oracle
+   (``process_decided_rounds``).
+
+Any store eviction or snapshot failure falls back to the oracle sweep for
+that round — consensus output is identical either way, and the node keeps
+running; the ``fallbacks`` counter surfaces it in /stats.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from babble_tpu.common.errors import StoreError
+
+logger = logging.getLogger("babble_tpu.hashgraph.accel")
+
+
+class TensorConsensus:
+    def __init__(self, sweep_events: int = 256, async_compile: bool = True):
+        # Force a sweep mid-batch once this many inserts accumulate, so the
+        # window tensors stay inside one shape bucket even under huge syncs.
+        # Normal cadence is one sweep per gossip round (core.sync flush).
+        self.sweep_events = sweep_events
+        # Compile window-shape buckets off the consensus thread: the first
+        # sweep of a new bucket would otherwise stall gossip for the XLA
+        # compile (seconds on CPU, tens of seconds cold on TPU) while
+        # holding the core lock. Until a bucket's kernels are ready the
+        # oracle carries consensus — output is identical either way.
+        self.async_compile = async_compile
+        self.sweeps = 0
+        self.fallbacks = 0
+        self.compile_waits = 0
+        self.last_sweep_s = 0.0
+        self.total_sweep_s = 0.0
+        self.last_window_events = 0
+        self._ready = set()
+        self._compiling = set()
+        self._lock = threading.Lock()
+
+    def should_sweep(self, pending_inserts: int) -> bool:
+        return pending_inserts >= self.sweep_events
+
+    @staticmethod
+    def _bucket(win) -> tuple:
+        return (
+            win.n_events,
+            win.member.shape[1],
+            win.member.shape[0],
+            win.psi.shape[0],
+        )
+
+    def _compile_bucket(self, key: tuple) -> None:
+        from babble_tpu.ops import voting
+
+        try:
+            t0 = time.perf_counter()
+            voting.precompile(*key)
+            logger.info(
+                "voting kernels ready for bucket %s in %.1fs",
+                key,
+                time.perf_counter() - t0,
+            )
+            with self._lock:
+                self._ready.add(key)
+        except Exception:
+            # Leave the bucket un-ready so a later sweep retries the
+            # background compile instead of stalling inline on it.
+            logger.warning("bucket %s precompile failed", key, exc_info=True)
+        finally:
+            with self._lock:
+                self._compiling.discard(key)
+
+    def sweep(self, hg) -> bool:
+        """One fame + round-received sweep. Returns False when the caller
+        must fall back to the oracle pipeline."""
+        from babble_tpu.ops import voting
+
+        t0 = time.perf_counter()
+        try:
+            win = voting.build_voting_window(hg)
+            if win is None:
+                return True  # nothing undecided
+            if self.async_compile:
+                key = self._bucket(win)
+                with self._lock:
+                    ready = key in self._ready
+                    kick = not ready and key not in self._compiling
+                    if kick:
+                        self._compiling.add(key)
+                if kick:
+                    threading.Thread(
+                        target=self._compile_bucket, args=(key,), daemon=True
+                    ).start()
+                if not ready:
+                    self.compile_waits += 1
+                    return False  # oracle carries this sweep
+            see, fame = voting.run_fame(win)
+            voting.apply_fame(hg, win, fame)
+            decided = voting.decided_mask(hg, win)
+            rr = voting.run_round_received(win, see, fame, decided)
+            voting.apply_round_received(hg, win, rr)
+        except Exception as err:
+            # Any failure — store eviction, a tunnel dropping mid-run, a
+            # device OOM — must degrade to the oracle, not kill the sync.
+            # Writebacks are ordered so no partial mutation precedes a
+            # fallible read (see apply_round_received), making the oracle
+            # re-run safe.
+            self.fallbacks += 1
+            if isinstance(err, StoreError):
+                logger.warning("accelerated sweep fell back to oracle: %s", err)
+            else:
+                logger.warning(
+                    "accelerated sweep fell back to oracle", exc_info=True
+                )
+            return False
+        self.sweeps += 1
+        self.last_window_events = len(win.hashes)
+        self.last_sweep_s = time.perf_counter() - t0
+        self.total_sweep_s += self.last_sweep_s
+        return True
+
+    def stats(self) -> dict:
+        avg_ms = (
+            1000.0 * self.total_sweep_s / self.sweeps if self.sweeps else 0.0
+        )
+        return {
+            "consensus_engine": "device",
+            "accel_sweeps": self.sweeps,
+            "accel_fallbacks": self.fallbacks,
+            "accel_compile_waits": self.compile_waits,
+            "accel_last_sweep_ms": round(1000.0 * self.last_sweep_s, 3),
+            "accel_avg_sweep_ms": round(avg_ms, 3),
+            "accel_last_window_events": self.last_window_events,
+        }

@@ -95,6 +95,12 @@ class Hashgraph:
         self.pending_loaded_events = 0
         self.commit_callback = commit_callback
         self.topological_index = 0
+        # Device consensus offload (TensorConsensus), attached by the node's
+        # core when --accelerator is on. When set, DecideFame and
+        # DecideRoundReceived run as batched device sweeps instead of per
+        # insert; inserts between sweeps are counted in _accel_pending.
+        self.accel = None
+        self._accel_pending = 0
 
         cs = store.cache_size()
         self._ancestor_cache = LRU(cs)
@@ -388,9 +394,36 @@ class Hashgraph:
     def insert_event_and_run_consensus(
         self, event: Event, set_wire_info: bool = False
     ) -> None:
-        """The per-event pipeline driver (reference: hashgraph.go:644-668)."""
+        """The per-event pipeline driver (reference: hashgraph.go:644-668).
+
+        With an accelerator attached, round/witness assignment still happens
+        per insert (it gates the insert-time first-descendant walk,
+        hashgraph.go:503-512, so it must track every insert exactly like the
+        reference), but the voting stages are deferred to a batched device
+        sweep — normally once per sync via flush_consensus, or mid-batch
+        when enough inserts accumulate."""
         self.insert_event(event, set_wire_info)
         self.divide_rounds()
+        if self.accel is not None:
+            self._accel_pending += 1
+            if self.accel.should_sweep(self._accel_pending):
+                self.run_consensus_sweep()
+            return
+        self.run_consensus_sweep()
+
+    def flush_consensus(self) -> None:
+        """Run any deferred accelerated consensus sweep (no-op without an
+        accelerator or pending inserts)."""
+        if self.accel is not None and self._accel_pending > 0:
+            self.run_consensus_sweep()
+
+    def run_consensus_sweep(self) -> None:
+        """One batched voting sweep: device kernels when available, oracle
+        stages otherwise. Output is identical either way."""
+        self._accel_pending = 0
+        if self.accel is not None and self.accel.sweep(self):
+            self.process_decided_rounds()
+            return
         self.decide_fame()
         self.decide_round_received()
         self.process_decided_rounds()
@@ -810,6 +843,7 @@ class Hashgraph:
         self.pending_rounds = PendingRoundsCache()
         self.pending_loaded_events = 0
         self.topological_index = 0
+        self._accel_pending = 0
 
         cs = self.store.cache_size()
         self._ancestor_cache = LRU(cs)
@@ -846,6 +880,7 @@ class Hashgraph:
                 events = topo(index * batch_size, batch_size)
                 for e in events:
                     self.insert_event_and_run_consensus(e, set_wire_info=True)
+                self.flush_consensus()
                 self.process_sig_pool()
                 if len(events) < batch_size:
                     break

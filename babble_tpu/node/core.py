@@ -86,6 +86,14 @@ class Core:
         self.hg = Hashgraph(store, self.commit)
         self.hg.init(genesis_peers)
 
+        if accelerated_verify:
+            # The same flag gates the consensus offload: fame and
+            # round-received come off the device in batched sweeps
+            # (reference hot loop: hashgraph.go:644-668).
+            from ..hashgraph.accel import TensorConsensus
+
+            self.hg.accel = TensorConsensus()
+
     # -- head/seq -----------------------------------------------------------
 
     def set_head_and_seq(self) -> None:
@@ -204,6 +212,10 @@ class Core:
         # (reference: core.go:264-270).
         if self.busy() or self.seq < 0:
             self.record_heads()
+
+        # One batched voting sweep per sync covers every event inserted
+        # above (device path; no-op on the oracle path).
+        self.hg.flush_consensus()
 
     def record_heads(self) -> None:
         """reference: core.go:274-289."""

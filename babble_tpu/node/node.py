@@ -99,6 +99,13 @@ class Node(StateManager):
     def init(self) -> None:
         """Pick the initial state (reference: node.go:128-164)."""
         if self.conf.accelerator:
+            # Resolve the device first: if the TPU link is down the probe
+            # times out and the accelerated path runs on host XLA instead
+            # of wedging the node at its first jax call.
+            from babble_tpu.ops.device import ensure_device
+
+            ensure_device()
+
             # Compile the batch-verify kernel before gossip starts so the
             # first sync doesn't stall behind a ~15 s XLA compile.
             from babble_tpu.ops.verify import warmup
@@ -209,7 +216,7 @@ class Node(StateManager):
 
     def get_stats(self) -> Dict[str, str]:
         """reference: node.go:277-294."""
-        return {
+        stats = {
             "last_consensus_round": str(self.get_last_consensus_round_index()),
             "last_block_index": str(self.get_last_block_index()),
             "consensus_events": str(self.core.get_consensus_events_count()),
@@ -222,6 +229,12 @@ class Node(StateManager):
             "state": str(self.get_state()),
             "moniker": self.core.validator.moniker,
         }
+        accel = self.core.hg.accel
+        if accel is not None:
+            stats.update({k: str(v) for k, v in accel.stats().items()})
+        else:
+            stats["consensus_engine"] = "oracle"
+        return stats
 
     # -- background ---------------------------------------------------------
 
@@ -316,6 +329,7 @@ class Node(StateManager):
         with self.core_lock:
             if self.core.busy():
                 self.core.add_self_event("")
+                self.core.hg.flush_consensus()
                 self.core.process_sig_pool()
 
     def _gossip(self, peer: Peer) -> None:

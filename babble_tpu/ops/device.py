@@ -1,0 +1,92 @@
+"""Device resolution for live nodes: probe the accelerator, fall back to CPU.
+
+Under the axon tunnel, ``jax.devices()`` blocks indefinitely when the TPU
+link is down (observed as the round-2 bench's "device tunnel timeout"). A
+node started with ``--accelerator`` must not wedge on that, so before any
+in-process jax backend initialization we probe the configured platform in a
+throwaway subprocess with a timeout; on failure this process is switched to
+the CPU backend — the same kernels run, just on host XLA — and the node
+keeps its accelerated code path.
+
+Also installs the persistent XLA compilation cache for live processes (the
+test conftest does this only for pytest runs): the secp256k1 ladder kernel
+takes ~15 s to compile per batch bucket, and the voting kernels compile per
+window-shape bucket, so warm restarts matter.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+logger = logging.getLogger("babble_tpu.ops.device")
+
+_lock = threading.Lock()
+_resolved: Optional[str] = None
+
+PROBE_TIMEOUT_S = float(os.environ.get("BABBLE_DEVICE_PROBE_TIMEOUT", "60"))
+
+
+def _setup_compile_cache(jax) -> None:
+    cache = os.environ.get(
+        "BABBLE_JAX_CACHE", os.path.expanduser("~/.cache/babble_tpu/jax")
+    )
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # cache is an optimization, never fatal
+        logger.debug("compilation cache unavailable", exc_info=True)
+
+
+def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
+    """Resolve the jax platform once per process, before any backend init.
+
+    Returns the platform this process will use ("cpu", the configured
+    platform, or "default"). Thread-safe; the probe runs at most once.
+    """
+    global _resolved
+    with _lock:
+        if _resolved is not None:
+            return _resolved
+        import jax
+
+        _setup_compile_cache(jax)
+
+        cfg = jax.config.jax_platforms  # set by conftest or earlier callers
+        target = cfg or os.environ.get("JAX_PLATFORMS", "")
+        # Only the FIRST platform matters: "axon,cpu" initializes axon and
+        # blocks on a dead tunnel despite the cpu entry behind it.
+        preferred = target.split(",")[0] if target else ""
+        if preferred in ("", "cpu"):
+            _resolved = target or "default"
+            return _resolved
+
+        try:
+            # The child only inherits os.environ, so pin the platform there
+            # in case it was configured via jax.config in this process.
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+                env={**os.environ, "JAX_PLATFORMS": target},
+            )
+            ok = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        if ok:
+            _resolved = target
+        else:
+            logger.warning(
+                "platform %r unreachable (probe timeout %.0fs); "
+                "falling back to CPU XLA for the accelerated path",
+                target,
+                timeout_s,
+            )
+            jax.config.update("jax_platforms", "cpu")
+            _resolved = "cpu"
+        return _resolved

@@ -1,0 +1,535 @@
+"""Live consensus offload: fame + round-received as device tensor programs.
+
+This is the kernel behind ``--accelerator``'s consensus path. The division of
+labour with the host is deliberate and reference-exact:
+
+- The host keeps the *incremental* bookkeeping the reference does per insert —
+  signature checks, fork prevention, coordinate maintenance, and round/witness
+  assignment (reference: src/hashgraph/hashgraph.go:672-750, 807-872). These
+  walks gate insert-time semantics (the first-descendant walk stops at
+  witnesses, hashgraph.go:503-512) so they must observe exactly the state the
+  reference would; they are O(depth) per event and cheap.
+- The device takes the *batch* work that dominates the pipeline — virtual
+  voting (DecideFame, hashgraph.go:875-998) and round-received
+  (DecideRoundReceived, hashgraph.go:1002-1095), which are
+  O(window² · rounds) — as masked matmuls and boolean reductions over a
+  dense window snapshot.
+
+Unlike :mod:`babble_tpu.ops.dag` (the all-at-once pipeline used by the bench
+and the multi-chip dryrun), these kernels support **dynamic membership**:
+peer-sets vary per round, so the peer axis is padded to the full repertoire
+and each round carries a peer-set slot (``psi``) selecting a membership mask
+and super-majority threshold (reference: per-round peer-sets in DecideFame,
+hashgraph.go:875-998, interval lookup caches.go:126-222).
+
+The sweep is split in two device calls with a host step between them because
+the oracle's round-decided flag is *sticky* (roundInfo.go:73-96): a round
+once decided stays decided even if a laggard later inserts an undecided
+witness into it. Fame comes off the device, the host applies it to the round
+infos (computing decidedness with the oracle's own sticky rule), and the
+round-received kernel then takes the per-round decided mask as an input. The
+``see`` matrix stays on device between the two calls.
+
+Shapes are padded to buckets (E to a power of two, R to a multiple of 8, P
+to a multiple of 8, S to a power of two) so XLA compiles once per bucket and
+the jit cache stays warm across sweeps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from babble_tpu.common.errors import StoreError
+from babble_tpu.common.trilean import Trilean
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# Frequency of coin rounds (reference: hashgraph.go:24-25). Kept in sync with
+# babble_tpu.hashgraph.hashgraph.COIN_ROUND_FREQ.
+COIN_ROUND_FREQ = 4
+
+# Row-block size for the strongly-see reduction: bounds the [B, E, P]
+# broadcast-compare intermediate instead of materializing [E, E, P].
+SS_BLOCK = 64
+
+
+def _bucket_pow2(n: int, minimum: int) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _bucket_mult(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+@dataclass
+class VotingWindow:
+    """Dense window over the undecided suffix of the hashgraph.
+
+    E rows = undetermined events + all witnesses of rounds >= the window
+    floor; rounds are rebased by ``base`` so in-kernel round indexes stay
+    small regardless of absolute round numbers.
+    """
+
+    creator: np.ndarray  # [E] int32 peer column of creator (0 for padding)
+    index: np.ndarray  # [E] int32 per-creator sequence (-1 padding)
+    last_ancestors: np.ndarray  # [E, P] int32, -1 missing
+    first_descendants: np.ndarray  # [E, P] int32, INT32_MAX missing
+    rounds: np.ndarray  # [E] int32 rebased round (-10 padding)
+    witness: np.ndarray  # [E] bool
+    fame0: np.ndarray  # [E] int32 {-1, 0, 1} initial fame from round infos
+    middle_bit: np.ndarray  # [E] bool
+    valid: np.ndarray  # [E] bool
+    undet: np.ndarray  # [E] bool — rows eligible for round-received
+    member: np.ndarray  # [S, P] bool peer-set membership masks
+    sm_s: np.ndarray  # [S] int32 super-majority per peer-set slot
+    psi: np.ndarray  # [R] int32 rebased-round -> peer-set slot
+    sm_r: np.ndarray  # [R] int32 rebased-round -> super-majority
+    base: int  # absolute round of rebased round 0
+    lower_bound: int  # rebased fast-sync lower bound, -1 if none
+    hashes: List[str] = field(default_factory=list)  # real rows only
+    row: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def n_events(self) -> int:
+        return int(self.creator.shape[0])
+
+
+# =============================================================================
+# Kernels
+# =============================================================================
+
+
+def _see_matrix(creator, index, la, valid):
+    """SEE[x, y] = x sees y (oracle: hashgraph.go:96-128 via lastAncestors)."""
+    la_xc = la[:, creator]  # [E(x), E(y)]
+    see = la_xc >= index[None, :]
+    return see & valid[:, None] & valid[None, :]
+
+
+def _strongly_see_by_set(la, fd, member, sm_s):
+    """SS[s, x, y] for every peer-set slot s, row-blocked so the broadcast
+    compare never materializes [E, E, P] (oracle: hashgraph.go:172-206 with
+    the per-round peer-set argument)."""
+    E, P = la.shape
+    member_i = member.astype(jnp.int32)  # [S, P]
+
+    block = SS_BLOCK if E % SS_BLOCK == 0 else E
+
+    def blk(la_b):
+        ge = (la_b[:, None, :] >= fd[None, :, :]).astype(jnp.int32)  # [B, E, P]
+        return jnp.einsum("byp,sp->sby", ge, member_i)  # [S, B, E]
+
+    counts = lax.map(blk, la.reshape(E // block, block, P))  # [nb, S, B, E]
+    counts = jnp.moveaxis(counts, 1, 0).reshape(member.shape[0], E, E)
+    return counts >= sm_s[:, None, None]
+
+
+def _fame_core(creator, index, la, fd, rounds, wit, fame0, mid, valid,
+               member, sm_s, psi, sm_r):
+    """Virtual voting (oracle: hashgraph.go:875-998) with per-round
+    peer-sets. Returns (see, fame); ``see`` stays on device for the
+    round-received kernel."""
+    E = creator.shape[0]
+    R = psi.shape[0]
+
+    see = _see_matrix(creator, index, la, valid)
+    ss_all = _strongly_see_by_set(la, fd, member, sm_s)  # [S, E, E]
+
+    def per_round(j, state):
+        votes, fame = state
+        voter = wit & (rounds == j)  # [E(y)]
+        diff = j - rounds  # [E(x)] per candidate
+
+        # Derived vote: majority among strongly-seen witnesses of j-1,
+        # evaluated against round j-1's peer-set (hashgraph.go:928-948).
+        prev_w = wit & (rounds == (j - 1))
+        slot_prev = psi[jnp.clip(j - 1, 0, R - 1)]
+        ss_prev = ss_all[slot_prev] & prev_w[None, :]  # [E(y), E(w)]
+        n_ss = jnp.sum(ss_prev, axis=1, dtype=jnp.int32)
+        yays = ss_prev.astype(jnp.int32) @ votes.astype(jnp.int32)
+        nays = n_ss[:, None] - yays
+        v = yays >= nays
+        t = jnp.maximum(yays, nays)
+        sm_j = sm_r[jnp.clip(j, 0, R - 1)]  # round j's super-majority
+        settled = t >= sm_j
+
+        is_coin = (diff % COIN_ROUND_FREQ) == 0
+        derived = jnp.where(is_coin[None, :] & ~settled, mid[:, None], v)
+        new_vote = jnp.where((diff == 1)[None, :], see, derived)
+
+        active = voter[:, None] & wit[None, :] & (diff >= 1)[None, :]
+        votes = jnp.where(active, new_vote, votes)
+
+        decide_pair = active & ~is_coin[None, :] & (diff > 1)[None, :] & settled
+        decided_now = jnp.any(decide_pair, axis=0)
+        decided_val = jnp.any(decide_pair & v, axis=0)
+        newly = decided_now & (fame == 0)
+        fame = jnp.where(newly, jnp.where(decided_val, 1, -1), fame)
+        return votes, fame
+
+    votes0 = jnp.zeros((E, E), bool)
+    votes, fame = lax.fori_loop(1, R, per_round, (votes0, fame0))
+    return see, fame
+
+
+def _rr_core(see, rounds, wit, fame, decided_r, sm_r, undet, lower_bound):
+    """Round-received (oracle: hashgraph.go:1002-1095). ``decided_r`` is the
+    host-computed sticky per-round decided mask; rounds below the fast-sync
+    ``lower_bound`` are skipped rather than blocking the scan
+    (hashgraph.go:1033-1046)."""
+    E = rounds.shape[0]
+    R = decided_r.shape[0]
+
+    def per_round(i, state):
+        rr, blocked = state
+        decided = decided_r[i]
+        fw = wit & (rounds == i) & (fame == 1)
+        n_fw = jnp.sum(fw, dtype=jnp.int32)
+        sees_x = see | (~fw)[:, None]
+        all_see = jnp.all(sees_x, axis=0) & (n_fw >= sm_r[jnp.clip(i, 0, R - 1)])
+        relevant = rounds < i
+        eligible = decided & ~blocked & relevant & (rr < 0) & all_see & undet
+        rr = jnp.where(eligible, i, rr)
+        blocked = blocked | (relevant & ~decided & (i > lower_bound))
+        return rr, blocked
+
+    rr0 = jnp.full(E, -1, jnp.int32)
+    blocked0 = jnp.zeros(E, bool)
+    rr, _ = lax.fori_loop(1, R, per_round, (rr0, blocked0))
+    return rr
+
+
+# Counts traces so tests can pin the compile-cache property.
+_trace_count = 0
+
+
+def _counting_fame(*args):
+    global _trace_count
+    _trace_count += 1
+    return _fame_core(*args)
+
+
+_fame_jit = jax.jit(_counting_fame)
+_rr_jit = jax.jit(_rr_core)
+
+
+# =============================================================================
+# Host side: window construction and result application
+# =============================================================================
+
+
+def _fame_init(trilean: Trilean) -> int:
+    if trilean == Trilean.TRUE:
+        return 1
+    if trilean == Trilean.FALSE:
+        return -1
+    return 0
+
+
+def build_voting_window(hg) -> Optional[VotingWindow]:
+    """Snapshot the undecided suffix of a Hashgraph into dense tensors.
+
+    Returns None when there is nothing to decide. Raises StoreError when a
+    needed event/round has been evicted — the caller falls back to the
+    oracle sweep in that case.
+
+    Window floor = min(first pending round, min round over undetermined
+    events): pending rounds can trail the undetermined set when all their
+    events were received before fame was decided, and vice versa, so both
+    bound the rows the vote and receive scans touch.
+    """
+    store = hg.store
+    undetermined = list(hg.undetermined_events)
+    pending = [pr.index for pr in hg.pending_rounds.get_ordered_pending_rounds()]
+    if not undetermined and not pending:
+        return None
+
+    floors = list(pending)
+    undet_rounds: Dict[str, int] = {}
+    for h in undetermined:
+        ev = store.get_event(h)
+        if ev.round is None:
+            return None  # divide_rounds has not run yet
+        undet_rounds[h] = ev.round
+        floors.append(ev.round)
+    base = min(floors)
+    last_round = store.last_round()
+
+    # Peer columns span the full repertoire so any peer-set's mask and any
+    # event's coordinates map onto the same axis.
+    rep = store.repertoire_by_pub_key()
+    pub_keys = sorted(rep.keys())
+    peer_col = {pk: i for i, pk in enumerate(pub_keys)}
+    n_peers = len(pub_keys)
+
+    # Rows: all undetermined events first (their list order is the oracle's
+    # scan order), then every witness of rounds >= base from the round infos.
+    hashes: List[str] = list(undetermined)
+    rows = {h: i for i, h in enumerate(hashes)}
+    witness_rows: Dict[str, tuple] = {}  # hash -> (round, famous)
+    for r in range(base, last_round + 1):
+        try:
+            ri = store.get_round(r)
+        except StoreError:
+            continue
+        for x, re_ in ri.created_events.items():
+            if re_.witness:
+                witness_rows[x] = (r, re_.famous)
+                if x not in rows:
+                    rows[x] = len(hashes)
+                    hashes.append(x)
+
+    E_real = len(hashes)
+    E = _bucket_pow2(E_real, 32)
+    P = _bucket_mult(n_peers, 8)
+    R_real = last_round - base + 2
+    R = _bucket_mult(R_real, 8)
+
+    creator = np.zeros(E, np.int32)
+    index = np.full(E, -1, np.int32)
+    la = np.full((E, P), -1, np.int32)
+    fd = np.full((E, P), INT32_MAX, np.int32)
+    rounds = np.full(E, -10, np.int32)
+    witness = np.zeros(E, bool)
+    fame0 = np.zeros(E, np.int32)
+    mid = np.zeros(E, bool)
+    valid = np.zeros(E, bool)
+    undet_mask = np.zeros(E, bool)
+
+    from babble_tpu.hashgraph.hashgraph import middle_bit
+
+    for h, i in rows.items():
+        ev = store.get_event(h)
+        creator[i] = peer_col[ev.creator()]
+        index[i] = ev.index()
+        for pk, coords in ev.last_ancestors.items():
+            c = peer_col.get(pk)
+            if c is not None:
+                la[i, c] = coords.index
+        for pk, coords in ev.first_descendants.items():
+            c = peer_col.get(pk)
+            if c is not None:
+                fd[i, c] = coords.index
+        if h in undet_rounds:
+            r_abs = undet_rounds[h]
+        else:
+            r_abs = witness_rows[h][0]
+        rounds[i] = r_abs - base
+        w = witness_rows.get(h)
+        if w is not None:
+            witness[i] = True
+            fame0[i] = _fame_init(w[1])
+        mid[i] = middle_bit(h)
+        valid[i] = True
+        undet_mask[i] = h in undet_rounds
+
+    # Per-round peer-sets: one slot per distinct set effective in the window
+    # (interval semantics of PeerSetCache.get, caches.go:169-193). Rounds
+    # past the last recorded change reuse the final set, which is exactly
+    # what the interval lookup returns.
+    slot_of: Dict[bytes, int] = {}
+    members: List[np.ndarray] = []
+    sms: List[int] = []
+    psi = np.zeros(R, np.int32)
+    sm_r = np.full(R, 2**30, np.int32)
+    for r in range(R):
+        ps = store.get_peer_set(base + r)
+        key = ps.hash()
+        s = slot_of.get(key)
+        if s is None:
+            s = len(members)
+            slot_of[key] = s
+            m = np.zeros(P, bool)
+            for pk in ps.pub_keys():
+                c = peer_col.get(pk)
+                if c is not None:
+                    m[c] = True
+            members.append(m)
+            sms.append(ps.super_majority())
+        psi[r] = s
+        sm_r[r] = sms[s]
+
+    S = _bucket_pow2(len(members), 1)
+    member = np.zeros((S, P), bool)
+    sm_s = np.full(S, 2**30, np.int32)
+    for s, m in enumerate(members):
+        member[s] = m
+        sm_s[s] = sms[s]
+
+    lb = -1
+    if hg.round_lower_bound is not None:
+        lb = hg.round_lower_bound - base
+
+    return VotingWindow(
+        creator=creator,
+        index=index,
+        last_ancestors=la,
+        first_descendants=fd,
+        rounds=rounds,
+        witness=witness,
+        fame0=fame0,
+        middle_bit=mid,
+        valid=valid,
+        undet=undet_mask,
+        member=member,
+        sm_s=sm_s,
+        psi=psi,
+        sm_r=sm_r,
+        base=base,
+        lower_bound=lb,
+        hashes=hashes,
+        row=rows,
+    )
+
+
+def precompile(E: int, P: int, S: int, R: int) -> None:
+    """Compile (or load from the persistent cache) both kernels for a shape
+    bucket by running them on an all-invalid dummy window. Called from a
+    background thread by TensorConsensus so live sweeps never stall on XLA
+    compilation."""
+    win = VotingWindow(
+        creator=np.zeros(E, np.int32),
+        index=np.full(E, -1, np.int32),
+        last_ancestors=np.full((E, P), -1, np.int32),
+        first_descendants=np.full((E, P), INT32_MAX, np.int32),
+        rounds=np.full(E, -10, np.int32),
+        witness=np.zeros(E, bool),
+        fame0=np.zeros(E, np.int32),
+        middle_bit=np.zeros(E, bool),
+        valid=np.zeros(E, bool),
+        undet=np.zeros(E, bool),
+        member=np.zeros((S, P), bool),
+        sm_s=np.full(S, 2**30, np.int32),
+        psi=np.zeros(R, np.int32),
+        sm_r=np.full(R, 2**30, np.int32),
+        base=0,
+        lower_bound=-1,
+    )
+    see, fame = run_fame(win)
+    run_round_received(win, see, fame, np.zeros(R, bool))
+
+
+def run_fame(win: VotingWindow):
+    """Device call 1: virtual voting. Returns (see_device, fame_host)."""
+    see, fame = _fame_jit(
+        jnp.asarray(win.creator),
+        jnp.asarray(win.index),
+        jnp.asarray(win.last_ancestors),
+        jnp.asarray(win.first_descendants),
+        jnp.asarray(win.rounds),
+        jnp.asarray(win.witness),
+        jnp.asarray(win.fame0),
+        jnp.asarray(win.middle_bit),
+        jnp.asarray(win.valid),
+        jnp.asarray(win.member),
+        jnp.asarray(win.sm_s),
+        jnp.asarray(win.psi),
+        jnp.asarray(win.sm_r),
+    )
+    return see, np.asarray(fame)
+
+
+def run_round_received(win: VotingWindow, see, fame: np.ndarray,
+                       decided_r: np.ndarray) -> np.ndarray:
+    """Device call 2: round-received, given the host-stamped sticky
+    per-round decided mask. ``see`` is the device array from run_fame."""
+    rr = _rr_jit(
+        see,
+        jnp.asarray(win.rounds),
+        jnp.asarray(win.witness),
+        jnp.asarray(fame),
+        jnp.asarray(decided_r),
+        jnp.asarray(win.sm_r),
+        jnp.asarray(win.undet),
+        np.int32(win.lower_bound),
+    )
+    return np.asarray(rr)
+
+
+def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
+    """Write fame into the pending rounds' infos and mark decided rounds
+    with the oracle's own sticky rule (mirrors the tail of
+    Hashgraph.decide_fame, hashgraph.go:985-996). Returns decided rounds."""
+    store = hg.store
+    decided_rounds: List[int] = []
+    for pr in hg.pending_rounds.get_ordered_pending_rounds():
+        try:
+            ri = store.get_round(pr.index)
+        except StoreError:
+            continue
+        ps = store.get_peer_set(pr.index)
+        for x, re_ in ri.created_events.items():
+            if not re_.witness or re_.famous != Trilean.UNDEFINED:
+                continue
+            i = win.row.get(x)
+            if i is None:
+                continue
+            f = int(fame[i])
+            if f != 0:
+                ri.set_fame(x, f == 1)
+        if ri.witnesses_decided(ps):
+            decided_rounds.append(pr.index)
+        store.set_round(pr.index, ri)
+    hg.pending_rounds.update(decided_rounds)
+    return decided_rounds
+
+
+def decided_mask(hg, win: VotingWindow) -> np.ndarray:
+    """Sticky per-round decided mask over the window's (rebased) round axis,
+    computed AFTER apply_fame so this sweep's decisions are visible. A round
+    with no info (evicted or never created) scans as undecided, which makes
+    the kernel block there — the oracle breaks on the missing round the same
+    way (hashgraph.go:1019-1026)."""
+    R = win.psi.shape[0]
+    out = np.zeros(R, bool)
+    for r in range(R):
+        a = win.base + r
+        try:
+            ri = hg.store.get_round(a)
+        except StoreError:
+            continue
+        try:
+            ps = hg.store.get_peer_set(a)
+        except StoreError:
+            continue
+        out[r] = ri.witnesses_decided(ps)
+    return out
+
+
+def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:
+    """Stamp received events and retire them from the undetermined list, in
+    the oracle's scan order (mirrors Hashgraph.decide_round_received,
+    hashgraph.go:1047-1091)."""
+    store = hg.store
+    # Two-phase: gather every fallible store read first so a StoreError can
+    # abort BEFORE any mutation — a partially-applied receive pass followed
+    # by the oracle fallback would double-receive events (add_received_event
+    # has no dedup) and fork the node's blocks from its peers'.
+    new_undetermined: List[str] = []
+    updates = []  # (event, round_received_abs, round_info)
+    for h in hg.undetermined_events:
+        i = win.row.get(h)
+        r = int(rr[i]) if i is not None else -1
+        if r >= 0:
+            a = r + win.base
+            updates.append((store.get_event(h), a, store.get_round(a)))
+        else:
+            new_undetermined.append(h)
+    for ev, a, tr in updates:
+        ev.set_round_received(a)
+        store.set_event(ev)
+        tr.add_received_event(ev.hex())
+        store.set_round(a, tr)
+    hg.undetermined_events = new_undetermined

@@ -155,16 +155,49 @@ def test_gossip_four_nodes_identical_blocks():
 
 
 def test_gossip_with_accelerated_verify():
-    """Same checkGossip oracle with the TPU batch-verify path enabled:
-    incoming sync batches are signature-checked through the JAX kernel
-    (babble_tpu/ops/verify.py) instead of per-event host ECDSA."""
+    """Same checkGossip oracle with the full TPU path enabled: incoming sync
+    batches are signature-checked through the JAX kernel
+    (babble_tpu/ops/verify.py) and fame/round-received decisions come off
+    the device in batched sweeps (babble_tpu/ops/voting.py) instead of the
+    per-insert oracle pipeline."""
     network = InmemNetwork()
     nodes, proxies, states = make_cluster(2, network, accelerator=True)
+    # Synchronous compile: the sweep assertions below must not race the
+    # background bucket warm-up on a cold XLA cache.
+    from babble_tpu.hashgraph.accel import TensorConsensus
+
+    for n in nodes:
+        n.core.hg.accel = TensorConsensus(async_compile=False)
     try:
         for n in nodes:
             n.run_async()
         bombard_and_wait(nodes, proxies, target_block=1, timeout=120.0)
         check_gossip(nodes, 0, 1)
+        for n in nodes:
+            stats = n.get_stats()
+            assert stats["consensus_engine"] == "device"
+            assert int(stats["accel_sweeps"]) > 0, "device never decided"
+            assert int(stats["accel_fallbacks"]) == 0
+    finally:
+        shutdown_all(nodes)
+
+
+def test_gossip_mixed_accelerated_and_oracle_nodes():
+    """An accelerated node and oracle nodes must stay in consensus — the
+    device path may only change WHERE decisions are computed, never their
+    values (determinism requirement, SURVEY.md hard-part 4)."""
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(3, network, accelerator=False)
+    # flip one node's consensus onto the device
+    from babble_tpu.hashgraph.accel import TensorConsensus
+
+    nodes[0].core.hg.accel = TensorConsensus(async_compile=False)
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=1, timeout=120.0)
+        check_gossip(nodes, 0, 1)
+        assert nodes[0].core.hg.accel.sweeps > 0
     finally:
         shutdown_all(nodes)
 
