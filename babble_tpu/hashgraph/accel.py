@@ -32,11 +32,19 @@ logger = logging.getLogger("babble_tpu.hashgraph.accel")
 
 
 class TensorConsensus:
-    def __init__(self, sweep_events: int = 256, async_compile: bool = True):
+    def __init__(self, sweep_events: int = 256, async_compile: bool = True,
+                 min_window: int | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
         self.sweep_events = sweep_events
+        # Crossover threshold: below this many undetermined events the
+        # incremental oracle beats the sweep's fixed dispatch cost, so small
+        # windows stay on the host and the device takes over exactly when
+        # the oracle's O(witnesses² · rounds) voting would start to crawl.
+        # None = resolve on first use (lower on a real accelerator, higher
+        # on the CPU-XLA fallback). 0 forces the device path (tests).
+        self.min_window = min_window
         # Compile window-shape buckets off the consensus thread: the first
         # sweep of a new bucket would otherwise stall gossip for the XLA
         # compile (seconds on CPU, tens of seconds cold on TPU) while
@@ -46,9 +54,13 @@ class TensorConsensus:
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0
+        self.small_windows = 0  # flushes routed to the oracle by min_window
         self.last_sweep_s = 0.0
         self.total_sweep_s = 0.0
         self.last_window_events = 0
+        # Per-stage rolling sums (seconds) for /debug and bench breakdowns.
+        self.stage_s = {"build": 0.0, "fame": 0.0, "apply": 0.0,
+                        "mask": 0.0, "rr": 0.0}
         self._ready = set()
         self._compiling = set()
         self._lock = threading.Lock()
@@ -56,9 +68,27 @@ class TensorConsensus:
     def should_sweep(self, pending_inserts: int) -> bool:
         return pending_inserts >= self.sweep_events
 
+    def use_device(self, undetermined: int) -> bool:
+        """Window-size gate: route small windows to the oracle."""
+        if self.min_window is None:
+            import os
+
+            from babble_tpu.ops.device import is_cpu_fallback
+
+            env = os.environ.get("BABBLE_ACCEL_MIN_WINDOW")
+            if env is not None:
+                self.min_window = int(env)
+            else:
+                self.min_window = 256 if is_cpu_fallback() else 64
+        if undetermined >= self.min_window:
+            return True
+        self.small_windows += 1
+        return False
+
     @staticmethod
     def _bucket(win) -> tuple:
         return (
+            win.n_witnesses,
             win.n_events,
             win.member.shape[1],
             win.member.shape[0],
@@ -110,11 +140,24 @@ class TensorConsensus:
                 if not ready:
                     self.compile_waits += 1
                     return False  # oracle carries this sweep
+            t1 = time.perf_counter()
+            self.stage_s["build"] += t1 - t0
             see, fame = voting.run_fame(win)
+            t2 = time.perf_counter()
+            self.stage_s["fame"] += t2 - t1
             voting.apply_fame(hg, win, fame)
+            t3 = time.perf_counter()
+            self.stage_s["apply"] += t3 - t2
             decided = voting.decided_mask(hg, win)
-            rr = voting.run_round_received(win, see, fame, decided)
-            voting.apply_round_received(hg, win, rr)
+            t4 = time.perf_counter()
+            self.stage_s["mask"] += t4 - t3
+            if decided.any():
+                # Receiving requires a decided round; with none in the
+                # window the kernel would return all -1, so skip the call.
+                rr = voting.run_round_received(win, see, fame, decided)
+                t5 = time.perf_counter()
+                self.stage_s["rr"] += t5 - t4
+                voting.apply_round_received(hg, win, rr)
         except Exception as err:
             # Any failure — store eviction, a tunnel dropping mid-run, a
             # device OOM — must degrade to the oracle, not kill the sync.
@@ -144,7 +187,12 @@ class TensorConsensus:
             "accel_sweeps": self.sweeps,
             "accel_fallbacks": self.fallbacks,
             "accel_compile_waits": self.compile_waits,
+            "accel_small_windows": self.small_windows,
+            "accel_min_window": self.min_window,
             "accel_last_sweep_ms": round(1000.0 * self.last_sweep_s, 3),
             "accel_avg_sweep_ms": round(avg_ms, 3),
             "accel_last_window_events": self.last_window_events,
+            "accel_stage_ms": {
+                k: round(1000.0 * v, 1) for k, v in self.stage_s.items()
+            },
         }

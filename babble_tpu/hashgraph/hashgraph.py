@@ -418,10 +418,15 @@ class Hashgraph:
             self.run_consensus_sweep()
 
     def run_consensus_sweep(self) -> None:
-        """One batched voting sweep: device kernels when available, oracle
-        stages otherwise. Output is identical either way."""
+        """One batched voting sweep: device kernels when the undecided
+        window is big enough to beat the dispatch cost, oracle stages
+        otherwise. Output is identical either way."""
         self._accel_pending = 0
-        if self.accel is not None and self.accel.sweep(self):
+        if (
+            self.accel is not None
+            and self.accel.use_device(len(self.undetermined_events))
+            and self.accel.sweep(self)
+        ):
             self.process_decided_rounds()
             return
         self.decide_fame()

@@ -165,7 +165,15 @@ class Core:
                     decoded.append(ev)
                     j += 1
                 if decoded:
-                    if self.accelerated_verify:
+                    use_device_verify = self.accelerated_verify
+                    if use_device_verify:
+                        # On the CPU-XLA fallback the limb kernel loses to
+                        # the native C++ verifier; the JAX path only pays
+                        # off on a real matrix unit.
+                        from babble_tpu.ops.device import is_cpu_fallback
+
+                        use_device_verify = not is_cpu_fallback()
+                    if use_device_verify:
                         from babble_tpu.ops.verify import prevalidate_events
 
                         prevalidate_events(decoded)

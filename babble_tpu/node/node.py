@@ -102,15 +102,19 @@ class Node(StateManager):
             # Resolve the device first: if the TPU link is down the probe
             # times out and the accelerated path runs on host XLA instead
             # of wedging the node at its first jax call.
-            from babble_tpu.ops.device import ensure_device
+            from babble_tpu.ops.device import ensure_device, is_cpu_fallback
 
             ensure_device()
 
-            # Compile the batch-verify kernel before gossip starts so the
-            # first sync doesn't stall behind a ~15 s XLA compile.
-            from babble_tpu.ops.verify import warmup
+            if not is_cpu_fallback():
+                # Compile the batch-verify kernel before gossip starts so
+                # the first sync doesn't stall behind a ~15 s XLA compile.
+                # On the CPU fallback signature verification routes to the
+                # native C++ verifier instead (core.sync), so there is
+                # nothing to warm.
+                from babble_tpu.ops.verify import warmup
 
-            warmup()
+                warmup()
         if self.conf.bootstrap:
             self.core.bootstrap()
             with self.core_lock:

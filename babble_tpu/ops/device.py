@@ -28,6 +28,21 @@ logger = logging.getLogger("babble_tpu.ops.device")
 _lock = threading.Lock()
 _resolved: Optional[str] = None
 
+
+def resolved() -> Optional[str]:
+    """The platform ensure_device() settled on, or None before any probe."""
+    return _resolved
+
+
+def is_cpu_fallback() -> bool:
+    """True when the accelerated path is running on host XLA (resolved
+    platform is cpu). Callers use this to route work where host XLA loses
+    to native host code — e.g. signature verification goes to the C++
+    batch verifier instead of the JAX limb kernel, whose only advantage is
+    a real matrix unit."""
+    r = _resolved
+    return r is not None and r.split(",")[0] == "cpu"
+
 PROBE_TIMEOUT_S = float(os.environ.get("BABBLE_DEVICE_PROBE_TIMEOUT", "60"))
 
 

@@ -11,9 +11,15 @@ labour with the host is deliberate and reference-exact:
   reference would; they are O(depth) per event and cheap.
 - The device takes the *batch* work that dominates the pipeline — virtual
   voting (DecideFame, hashgraph.go:875-998) and round-received
-  (DecideRoundReceived, hashgraph.go:1002-1095), which are
-  O(window² · rounds) — as masked matmuls and boolean reductions over a
-  dense window snapshot.
+  (DecideRoundReceived, hashgraph.go:1002-1095) — as masked matmuls and
+  boolean reductions over a dense window snapshot.
+
+Only witnesses vote and are voted on, so the vote state lives on a compact
+witness axis W instead of the full event axis E: fame is O(R·W²) and the
+see-visibility mask is [W, E], which keeps warm sweeps at
+milliseconds even when a large undecided window (E in the hundreds) has
+accumulated. (A dense [E, E] formulation measurably death-spirals: slow
+sweeps grow the window, which slows sweeps further.)
 
 Unlike :mod:`babble_tpu.ops.dag` (the all-at-once pipeline used by the bench
 and the multi-chip dryrun), these kernels support **dynamic membership**:
@@ -28,18 +34,16 @@ once decided stays decided even if a laggard later inserts an undecided
 witness into it. Fame comes off the device, the host applies it to the round
 infos (computing decidedness with the oracle's own sticky rule), and the
 round-received kernel then takes the per-round decided mask as an input. The
-``see`` matrix stays on device between the two calls.
+``see`` mask stays on device between the two calls.
 
-Shapes are padded to buckets (E to a power of two, R to a multiple of 8, P
-to a multiple of 8, S to a power of two) so XLA compiles once per bucket and
-the jit cache stays warm across sweeps.
+Shapes are padded to buckets (W and E to powers of two, R and P to multiples
+of 8, S to a power of two) so XLA compiles once per bucket and the jit cache
+stays warm across sweeps.
 """
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -57,10 +61,6 @@ INT32_MAX = np.int32(2**31 - 1)
 # babble_tpu.hashgraph.hashgraph.COIN_ROUND_FREQ.
 COIN_ROUND_FREQ = 4
 
-# Row-block size for the strongly-see reduction: bounds the [B, E, P]
-# broadcast-compare intermediate instead of materializing [E, E, P].
-SS_BLOCK = 64
-
 
 def _bucket_pow2(n: int, minimum: int) -> int:
     b = minimum
@@ -77,33 +77,47 @@ def _bucket_mult(n: int, m: int) -> int:
 class VotingWindow:
     """Dense window over the undecided suffix of the hashgraph.
 
-    E rows = undetermined events + all witnesses of rounds >= the window
-    floor; rounds are rebased by ``base`` so in-kernel round indexes stay
-    small regardless of absolute round numbers.
+    Two row spaces:
+    - E rows: undetermined events + all witnesses of rounds >= the window
+      floor (``hashes``/``row``). Carries creator/index/rounds/undet.
+    - W rows: the witness subset (``wit_hashes``/``wit_row``), indexing into
+      E rows via ``wit_idx``. Carries coordinates, fame state, coin bits.
+
+    Rounds are rebased by ``base`` so in-kernel round indexes stay small
+    regardless of absolute round numbers.
     """
 
+    # E-space
     creator: np.ndarray  # [E] int32 peer column of creator (0 for padding)
     index: np.ndarray  # [E] int32 per-creator sequence (-1 padding)
-    last_ancestors: np.ndarray  # [E, P] int32, -1 missing
-    first_descendants: np.ndarray  # [E, P] int32, INT32_MAX missing
     rounds: np.ndarray  # [E] int32 rebased round (-10 padding)
-    witness: np.ndarray  # [E] bool
-    fame0: np.ndarray  # [E] int32 {-1, 0, 1} initial fame from round infos
-    middle_bit: np.ndarray  # [E] bool
-    valid: np.ndarray  # [E] bool
     undet: np.ndarray  # [E] bool — rows eligible for round-received
-    member: np.ndarray  # [S, P] bool peer-set membership masks
-    sm_s: np.ndarray  # [S] int32 super-majority per peer-set slot
+    # W-space (witnesses)
+    wit_idx: np.ndarray  # [W] int32 row in E-space (0 for padding)
+    la_w: np.ndarray  # [W, P] int32, -1 missing
+    fd_w: np.ndarray  # [W, P] int32, INT32_MAX missing
+    rounds_w: np.ndarray  # [W] int32 rebased (-10 padding)
+    valid_w: np.ndarray  # [W] bool
+    fame0_w: np.ndarray  # [W] int32 {-1, 0, 1} initial fame from round infos
+    mid_w: np.ndarray  # [W] bool coin bits
+    # peer-sets per round
+    member: np.ndarray  # [S, P] bool membership masks
+    sm_s: np.ndarray  # [S] int32 super-majority per slot
     psi: np.ndarray  # [R] int32 rebased-round -> peer-set slot
     sm_r: np.ndarray  # [R] int32 rebased-round -> super-majority
     base: int  # absolute round of rebased round 0
-    lower_bound: int  # rebased fast-sync lower bound, -1 if none
-    hashes: List[str] = field(default_factory=list)  # real rows only
+    hashes: List[str] = field(default_factory=list)  # real E rows
     row: Dict[str, int] = field(default_factory=dict)
+    wit_hashes: List[str] = field(default_factory=list)  # real W rows
+    wit_row: Dict[str, int] = field(default_factory=dict)
 
     @property
     def n_events(self) -> int:
         return int(self.creator.shape[0])
+
+    @property
+    def n_witnesses(self) -> int:
+        return int(self.wit_idx.shape[0])
 
 
 # =============================================================================
@@ -111,52 +125,34 @@ class VotingWindow:
 # =============================================================================
 
 
-def _see_matrix(creator, index, la, valid):
-    """SEE[x, y] = x sees y (oracle: hashgraph.go:96-128 via lastAncestors)."""
-    la_xc = la[:, creator]  # [E(x), E(y)]
-    see = la_xc >= index[None, :]
-    return see & valid[:, None] & valid[None, :]
-
-
-def _strongly_see_by_set(la, fd, member, sm_s):
-    """SS[s, x, y] for every peer-set slot s, row-blocked so the broadcast
-    compare never materializes [E, E, P] (oracle: hashgraph.go:172-206 with
-    the per-round peer-set argument)."""
-    E, P = la.shape
-    member_i = member.astype(jnp.int32)  # [S, P]
-
-    block = SS_BLOCK if E % SS_BLOCK == 0 else E
-
-    def blk(la_b):
-        ge = (la_b[:, None, :] >= fd[None, :, :]).astype(jnp.int32)  # [B, E, P]
-        return jnp.einsum("byp,sp->sby", ge, member_i)  # [S, B, E]
-
-    counts = lax.map(blk, la.reshape(E // block, block, P))  # [nb, S, B, E]
-    counts = jnp.moveaxis(counts, 1, 0).reshape(member.shape[0], E, E)
-    return counts >= sm_s[:, None, None]
-
-
-def _fame_core(creator, index, la, fd, rounds, wit, fame0, mid, valid,
-               member, sm_s, psi, sm_r):
-    """Virtual voting (oracle: hashgraph.go:875-998) with per-round
-    peer-sets. Returns (see, fame); ``see`` stays on device for the
-    round-received kernel."""
-    E = creator.shape[0]
+def _fame_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
+               wit_idx, member, sm_s, psi, sm_r):
+    """Virtual voting on the witness axis (oracle: hashgraph.go:875-998)
+    with per-round peer-sets. Returns (see_we, fame_w); ``see_we`` ([W, E],
+    witness w sees event x) stays on device for the round-received kernel."""
     R = psi.shape[0]
 
-    see = _see_matrix(creator, index, la, valid)
-    ss_all = _strongly_see_by_set(la, fd, member, sm_s)  # [S, E, E]
+    # SEE[w, x] = w sees x via lastAncestors (oracle: hashgraph.go:96-128).
+    see_we = (la_w[:, creator] >= index[None, :]) & valid_w[:, None]
+    see_ww = see_we[:, wit_idx]  # witness-to-witness visibility
+
+    # SS[s, w, w'] per peer-set slot (oracle: hashgraph.go:172-206 with the
+    # per-round peer-set argument). [W, W, P] compare stays small because W
+    # is the witness count, not the event count.
+    ge = (la_w[:, None, :] >= fd_w[None, :, :]).astype(jnp.int32)
+    counts = jnp.einsum("vwp,sp->svw", ge, member.astype(jnp.int32))
+    ss_all = counts >= sm_s[:, None, None]  # [S, W, W]
 
     def per_round(j, state):
         votes, fame = state
-        voter = wit & (rounds == j)  # [E(y)]
-        diff = j - rounds  # [E(x)] per candidate
+        voter = valid_w & (rounds_w == j)  # [W(y)]
+        diff = j - rounds_w  # [W(x)] per candidate
 
         # Derived vote: majority among strongly-seen witnesses of j-1,
         # evaluated against round j-1's peer-set (hashgraph.go:928-948).
-        prev_w = wit & (rounds == (j - 1))
+        prev_w = valid_w & (rounds_w == (j - 1))
         slot_prev = psi[jnp.clip(j - 1, 0, R - 1)]
-        ss_prev = ss_all[slot_prev] & prev_w[None, :]  # [E(y), E(w)]
+        ss_prev = ss_all[slot_prev] & prev_w[None, :]  # [W(y), W(w)]
         n_ss = jnp.sum(ss_prev, axis=1, dtype=jnp.int32)
         yays = ss_prev.astype(jnp.int32) @ votes.astype(jnp.int32)
         nays = n_ss[:, None] - yays
@@ -166,10 +162,10 @@ def _fame_core(creator, index, la, fd, rounds, wit, fame0, mid, valid,
         settled = t >= sm_j
 
         is_coin = (diff % COIN_ROUND_FREQ) == 0
-        derived = jnp.where(is_coin[None, :] & ~settled, mid[:, None], v)
-        new_vote = jnp.where((diff == 1)[None, :], see, derived)
+        derived = jnp.where(is_coin[None, :] & ~settled, mid_w[:, None], v)
+        new_vote = jnp.where((diff == 1)[None, :], see_ww, derived)
 
-        active = voter[:, None] & wit[None, :] & (diff >= 1)[None, :]
+        active = voter[:, None] & valid_w[None, :] & (diff >= 1)[None, :]
         votes = jnp.where(active, new_vote, votes)
 
         decide_pair = active & ~is_coin[None, :] & (diff > 1)[None, :] & settled
@@ -179,30 +175,35 @@ def _fame_core(creator, index, la, fd, rounds, wit, fame0, mid, valid,
         fame = jnp.where(newly, jnp.where(decided_val, 1, -1), fame)
         return votes, fame
 
-    votes0 = jnp.zeros((E, E), bool)
-    votes, fame = lax.fori_loop(1, R, per_round, (votes0, fame0))
-    return see, fame
+    W = rounds_w.shape[0]
+    votes0 = jnp.zeros((W, W), bool)
+    votes, fame = lax.fori_loop(1, R, per_round, (votes0, fame0_w))
+    return see_we, fame
 
 
-def _rr_core(see, rounds, wit, fame, decided_r, sm_r, undet, lower_bound):
-    """Round-received (oracle: hashgraph.go:1002-1095). ``decided_r`` is the
-    host-computed sticky per-round decided mask; rounds below the fast-sync
-    ``lower_bound`` are skipped rather than blocking the scan
-    (hashgraph.go:1033-1046)."""
-    E = rounds.shape[0]
+def _rr_core(see_we, rounds_w, valid_w, fame_w, rounds_e, undet_e,
+             decided_r, hard_block_r, sm_r):
+    """Round-received (oracle: hashgraph.go:1002-1095). ``decided_r`` and
+    ``hard_block_r`` are host-computed per-round masks carrying the oracle's
+    exact scan semantics: an event's ascending round scan stops at the
+    first hard-blocking round after its own (a missing round info, or an
+    undecided round above the fast-sync lower bound — hashgraph.go:1019-1046)
+    and receives only at decided rounds."""
+    E = rounds_e.shape[0]
     R = decided_r.shape[0]
 
     def per_round(i, state):
         rr, blocked = state
-        decided = decided_r[i]
-        fw = wit & (rounds == i) & (fame == 1)
+        fw = valid_w & (rounds_w == i) & (fame_w == 1)  # famous witnesses of i
         n_fw = jnp.sum(fw, dtype=jnp.int32)
-        sees_x = see | (~fw)[:, None]
+        sees_x = see_we | (~fw)[:, None]
         all_see = jnp.all(sees_x, axis=0) & (n_fw >= sm_r[jnp.clip(i, 0, R - 1)])
-        relevant = rounds < i
-        eligible = decided & ~blocked & relevant & (rr < 0) & all_see & undet
+        relevant = rounds_e < i
+        eligible = (
+            decided_r[i] & ~blocked & relevant & (rr < 0) & all_see & undet_e
+        )
         rr = jnp.where(eligible, i, rr)
-        blocked = blocked | (relevant & ~decided & (i > lower_bound))
+        blocked = blocked | (relevant & hard_block_r[i])
         return rr, blocked
 
     rr0 = jnp.full(E, -1, jnp.int32)
@@ -274,11 +275,12 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
     peer_col = {pk: i for i, pk in enumerate(pub_keys)}
     n_peers = len(pub_keys)
 
-    # Rows: all undetermined events first (their list order is the oracle's
-    # scan order), then every witness of rounds >= base from the round infos.
+    # E rows: all undetermined events first (their list order is the
+    # oracle's scan order), then every witness of rounds >= base from the
+    # round infos. W rows: the witness subset.
     hashes: List[str] = list(undetermined)
     rows = {h: i for i, h in enumerate(hashes)}
-    witness_rows: Dict[str, tuple] = {}  # hash -> (round, famous)
+    witness_info: Dict[str, tuple] = {}  # hash -> (round, famous)
     for r in range(base, last_round + 1):
         try:
             ri = store.get_round(r)
@@ -286,27 +288,32 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
             continue
         for x, re_ in ri.created_events.items():
             if re_.witness:
-                witness_rows[x] = (r, re_.famous)
+                witness_info[x] = (r, re_.famous)
                 if x not in rows:
                     rows[x] = len(hashes)
                     hashes.append(x)
+    wit_hashes = list(witness_info.keys())
+    wit_rows = {h: i for i, h in enumerate(wit_hashes)}
 
     E_real = len(hashes)
+    W_real = len(wit_hashes)
     E = _bucket_pow2(E_real, 32)
+    W = _bucket_pow2(W_real, 16)
     P = _bucket_mult(n_peers, 8)
     R_real = last_round - base + 2
     R = _bucket_mult(R_real, 8)
 
     creator = np.zeros(E, np.int32)
     index = np.full(E, -1, np.int32)
-    la = np.full((E, P), -1, np.int32)
-    fd = np.full((E, P), INT32_MAX, np.int32)
     rounds = np.full(E, -10, np.int32)
-    witness = np.zeros(E, bool)
-    fame0 = np.zeros(E, np.int32)
-    mid = np.zeros(E, bool)
-    valid = np.zeros(E, bool)
     undet_mask = np.zeros(E, bool)
+    wit_idx = np.zeros(W, np.int32)
+    la_w = np.full((W, P), -1, np.int32)
+    fd_w = np.full((W, P), INT32_MAX, np.int32)
+    rounds_w = np.full(W, -10, np.int32)
+    valid_w = np.zeros(W, bool)
+    fame0_w = np.zeros(W, np.int32)
+    mid_w = np.zeros(W, bool)
 
     from babble_tpu.hashgraph.hashgraph import middle_bit
 
@@ -314,26 +321,27 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
         ev = store.get_event(h)
         creator[i] = peer_col[ev.creator()]
         index[i] = ev.index()
-        for pk, coords in ev.last_ancestors.items():
-            c = peer_col.get(pk)
-            if c is not None:
-                la[i, c] = coords.index
-        for pk, coords in ev.first_descendants.items():
-            c = peer_col.get(pk)
-            if c is not None:
-                fd[i, c] = coords.index
         if h in undet_rounds:
             r_abs = undet_rounds[h]
         else:
-            r_abs = witness_rows[h][0]
+            r_abs = witness_info[h][0]
         rounds[i] = r_abs - base
-        w = witness_rows.get(h)
-        if w is not None:
-            witness[i] = True
-            fame0[i] = _fame_init(w[1])
-        mid[i] = middle_bit(h)
-        valid[i] = True
         undet_mask[i] = h in undet_rounds
+        w = wit_rows.get(h)
+        if w is not None:
+            wit_idx[w] = i
+            rounds_w[w] = r_abs - base
+            valid_w[w] = True
+            fame0_w[w] = _fame_init(witness_info[h][1])
+            mid_w[w] = middle_bit(h)
+            for pk, coords in ev.last_ancestors.items():
+                c = peer_col.get(pk)
+                if c is not None:
+                    la_w[w, c] = coords.index
+            for pk, coords in ev.first_descendants.items():
+                c = peer_col.get(pk)
+                if c is not None:
+                    fd_w[w, c] = coords.index
 
     # Per-round peer-sets: one slot per distinct set effective in the window
     # (interval semantics of PeerSetCache.get, caches.go:169-193). Rounds
@@ -368,33 +376,31 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
         member[s] = m
         sm_s[s] = sms[s]
 
-    lb = -1
-    if hg.round_lower_bound is not None:
-        lb = hg.round_lower_bound - base
-
     return VotingWindow(
         creator=creator,
         index=index,
-        last_ancestors=la,
-        first_descendants=fd,
         rounds=rounds,
-        witness=witness,
-        fame0=fame0,
-        middle_bit=mid,
-        valid=valid,
         undet=undet_mask,
+        wit_idx=wit_idx,
+        la_w=la_w,
+        fd_w=fd_w,
+        rounds_w=rounds_w,
+        valid_w=valid_w,
+        fame0_w=fame0_w,
+        mid_w=mid_w,
         member=member,
         sm_s=sm_s,
         psi=psi,
         sm_r=sm_r,
         base=base,
-        lower_bound=lb,
         hashes=hashes,
         row=rows,
+        wit_hashes=wit_hashes,
+        wit_row=wit_rows,
     )
 
 
-def precompile(E: int, P: int, S: int, R: int) -> None:
+def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
     """Compile (or load from the persistent cache) both kernels for a shape
     bucket by running them on an all-invalid dummy window. Called from a
     background thread by TensorConsensus so live sweeps never stall on XLA
@@ -402,23 +408,23 @@ def precompile(E: int, P: int, S: int, R: int) -> None:
     win = VotingWindow(
         creator=np.zeros(E, np.int32),
         index=np.full(E, -1, np.int32),
-        last_ancestors=np.full((E, P), -1, np.int32),
-        first_descendants=np.full((E, P), INT32_MAX, np.int32),
         rounds=np.full(E, -10, np.int32),
-        witness=np.zeros(E, bool),
-        fame0=np.zeros(E, np.int32),
-        middle_bit=np.zeros(E, bool),
-        valid=np.zeros(E, bool),
         undet=np.zeros(E, bool),
+        wit_idx=np.zeros(W, np.int32),
+        la_w=np.full((W, P), -1, np.int32),
+        fd_w=np.full((W, P), INT32_MAX, np.int32),
+        rounds_w=np.full(W, -10, np.int32),
+        valid_w=np.zeros(W, bool),
+        fame0_w=np.zeros(W, np.int32),
+        mid_w=np.zeros(W, bool),
         member=np.zeros((S, P), bool),
         sm_s=np.full(S, 2**30, np.int32),
         psi=np.zeros(R, np.int32),
         sm_r=np.full(R, 2**30, np.int32),
         base=0,
-        lower_bound=-1,
     )
     see, fame = run_fame(win)
-    run_round_received(win, see, fame, np.zeros(R, bool))
+    run_round_received(win, see, fame, np.zeros(R, bool), np.zeros(R, bool))
 
 
 def run_fame(win: VotingWindow):
@@ -426,13 +432,13 @@ def run_fame(win: VotingWindow):
     see, fame = _fame_jit(
         jnp.asarray(win.creator),
         jnp.asarray(win.index),
-        jnp.asarray(win.last_ancestors),
-        jnp.asarray(win.first_descendants),
-        jnp.asarray(win.rounds),
-        jnp.asarray(win.witness),
-        jnp.asarray(win.fame0),
-        jnp.asarray(win.middle_bit),
-        jnp.asarray(win.valid),
+        jnp.asarray(win.la_w),
+        jnp.asarray(win.fd_w),
+        jnp.asarray(win.rounds_w),
+        jnp.asarray(win.valid_w),
+        jnp.asarray(win.fame0_w),
+        jnp.asarray(win.mid_w),
+        jnp.asarray(win.wit_idx),
         jnp.asarray(win.member),
         jnp.asarray(win.sm_s),
         jnp.asarray(win.psi),
@@ -442,18 +448,21 @@ def run_fame(win: VotingWindow):
 
 
 def run_round_received(win: VotingWindow, see, fame: np.ndarray,
-                       decided_r: np.ndarray) -> np.ndarray:
+                       decided_r: np.ndarray,
+                       hard_block_r: np.ndarray) -> np.ndarray:
     """Device call 2: round-received, given the host-stamped sticky
-    per-round decided mask. ``see`` is the device array from run_fame."""
+    per-round masks from round_masks. ``see`` is the [W, E] device array
+    from run_fame."""
     rr = _rr_jit(
         see,
-        jnp.asarray(win.rounds),
-        jnp.asarray(win.witness),
+        jnp.asarray(win.rounds_w),
+        jnp.asarray(win.valid_w),
         jnp.asarray(fame),
-        jnp.asarray(decided_r),
-        jnp.asarray(win.sm_r),
+        jnp.asarray(win.rounds),
         jnp.asarray(win.undet),
-        np.int32(win.lower_bound),
+        jnp.asarray(decided_r),
+        jnp.asarray(hard_block_r),
+        jnp.asarray(win.sm_r),
     )
     return np.asarray(rr)
 
@@ -473,7 +482,7 @@ def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
         for x, re_ in ri.created_events.items():
             if not re_.witness or re_.famous != Trilean.UNDEFINED:
                 continue
-            i = win.row.get(x)
+            i = win.wit_row.get(x)
             if i is None:
                 continue
             f = int(fame[i])
@@ -486,26 +495,35 @@ def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
     return decided_rounds
 
 
-def decided_mask(hg, win: VotingWindow) -> np.ndarray:
-    """Sticky per-round decided mask over the window's (rebased) round axis,
-    computed AFTER apply_fame so this sweep's decisions are visible. A round
-    with no info (evicted or never created) scans as undecided, which makes
-    the kernel block there — the oracle breaks on the missing round the same
-    way (hashgraph.go:1019-1026)."""
+def round_masks(hg, win: VotingWindow):
+    """(decided, hard_block) masks over the window's (rebased) round axis,
+    computed AFTER apply_fame so this sweep's decisions are visible, with
+    the oracle's exact scan-stopping semantics (hashgraph.go:1019-1046):
+
+    - a round with no info (evicted or never created) HARD-BLOCKS the scan
+      unconditionally — the oracle breaks on the StoreError;
+    - an undecided round hard-blocks only above the fast-sync lower bound;
+      at or below it the oracle `continue`s past the round.
+
+    ``witnesses_decided`` uses the oracle's own sticky rule, so a round
+    that decided before a laggard's late witness arrived stays decided.
+    """
     R = win.psi.shape[0]
-    out = np.zeros(R, bool)
+    decided = np.zeros(R, bool)
+    hard_block = np.zeros(R, bool)
+    lb = hg.round_lower_bound
     for r in range(R):
         a = win.base + r
         try:
             ri = hg.store.get_round(a)
-        except StoreError:
-            continue
-        try:
             ps = hg.store.get_peer_set(a)
         except StoreError:
+            hard_block[r] = True
             continue
-        out[r] = ri.witnesses_decided(ps)
-    return out
+        decided[r] = ri.witnesses_decided(ps)
+        if not decided[r] and (lb is None or lb < a):
+            hard_block[r] = True
+    return decided, hard_block
 
 
 def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:
@@ -516,20 +534,27 @@ def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:
     # Two-phase: gather every fallible store read first so a StoreError can
     # abort BEFORE any mutation — a partially-applied receive pass followed
     # by the oracle fallback would double-receive events (add_received_event
-    # has no dedup) and fork the node's blocks from its peers'.
+    # has no dedup) and fork the node's blocks from its peers'. Each round's
+    # info is fetched ONCE and shared by all its received events: a store
+    # that deserializes fresh copies per get (the persistent store) would
+    # otherwise keep only the last event of a round.
     new_undetermined: List[str] = []
-    updates = []  # (event, round_received_abs, round_info)
+    updates = []  # (event, round_received_abs)
+    round_infos = {}  # round -> RoundInfo, fetched once
     for h in hg.undetermined_events:
         i = win.row.get(h)
         r = int(rr[i]) if i is not None else -1
         if r >= 0:
             a = r + win.base
-            updates.append((store.get_event(h), a, store.get_round(a)))
+            if a not in round_infos:
+                round_infos[a] = store.get_round(a)
+            updates.append((store.get_event(h), a))
         else:
             new_undetermined.append(h)
-    for ev, a, tr in updates:
+    for ev, a in updates:
         ev.set_round_received(a)
         store.set_event(ev)
-        tr.add_received_event(ev.hex())
+        round_infos[a].add_received_event(ev.hex())
+    for a, tr in round_infos.items():
         store.set_round(a, tr)
     hg.undetermined_events = new_undetermined

@@ -53,7 +53,9 @@ def _replay(ordered, peer_set, sweep_events=None):
     if sweep_events is not None:
         # async_compile off: tests need deterministic device sweeps, not
         # oracle-carried ones while a background compile warms up.
-        h.accel = TensorConsensus(sweep_events=sweep_events, async_compile=False)
+        # min_window=0 forces the device path regardless of window size.
+        h.accel = TensorConsensus(sweep_events=sweep_events,
+                                  async_compile=False, min_window=0)
     for ev in ordered:
         h.insert_event_and_run_consensus(Event(ev.body, ev.signature),
                                          set_wire_info=True)

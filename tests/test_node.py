@@ -167,7 +167,7 @@ def test_gossip_with_accelerated_verify():
     from babble_tpu.hashgraph.accel import TensorConsensus
 
     for n in nodes:
-        n.core.hg.accel = TensorConsensus(async_compile=False)
+        n.core.hg.accel = TensorConsensus(async_compile=False, min_window=0)
     try:
         for n in nodes:
             n.run_async()
@@ -191,7 +191,8 @@ def test_gossip_mixed_accelerated_and_oracle_nodes():
     # flip one node's consensus onto the device
     from babble_tpu.hashgraph.accel import TensorConsensus
 
-    nodes[0].core.hg.accel = TensorConsensus(async_compile=False)
+    nodes[0].core.hg.accel = TensorConsensus(async_compile=False,
+                                             min_window=0)
     try:
         for n in nodes:
             n.run_async()
