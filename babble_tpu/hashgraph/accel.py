@@ -148,13 +148,14 @@ class TensorConsensus:
             voting.apply_fame(hg, win, fame)
             t3 = time.perf_counter()
             self.stage_s["apply"] += t3 - t2
-            decided = voting.decided_mask(hg, win)
+            decided, hard_block = voting.round_masks(hg, win)
             t4 = time.perf_counter()
             self.stage_s["mask"] += t4 - t3
             if decided.any():
                 # Receiving requires a decided round; with none in the
                 # window the kernel would return all -1, so skip the call.
-                rr = voting.run_round_received(win, see, fame, decided)
+                rr = voting.run_round_received(win, see, fame, decided,
+                                               hard_block)
                 t5 = time.perf_counter()
                 self.stage_s["rr"] += t5 - t4
                 voting.apply_round_received(hg, win, rr)
