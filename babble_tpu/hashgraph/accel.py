@@ -6,13 +6,21 @@ DecideRoundReceived to batched device sweeps (the reference runs them per
 insert, hashgraph.go:644-668; here a sweep covers a whole sync batch so
 device dispatch amortizes across the gossip round — SURVEY.md hard-part 6).
 
-A sweep:
-1. snapshots the undecided window (``ops.voting.build_voting_window``),
-2. runs fame on device, applies it host-side with the oracle's sticky
-   round-decided bookkeeping,
-3. runs round-received on device with the host-stamped decided mask,
-4. leaves frame/block construction to the untouched oracle
-   (``process_decided_rounds``).
+Two modes, chosen by the measured economics of the device link:
+
+- **Synchronous** (CPU-XLA fallback, tests): one fused device call per
+  flush — snapshot the undecided window, run fame + decidedness +
+  round-received in one compiled program, read back one buffer, apply.
+
+- **Pipelined** (real accelerator): a device→host readback through the
+  tunnel costs ~65-100 ms flat, so the flush path never waits for one.
+  Each flush first applies the PREVIOUS sweep's results (read back by a
+  background thread while gossip continued — the readback releases the
+  GIL), then snapshots and launches the next sweep (sub-millisecond
+  dispatch). Applying a snapshot's decisions after later inserts is exactly
+  the hashgraph's incremental == batch property — the same property the
+  reference's per-insert pipeline relies on — so consensus output is
+  bit-identical; only decision latency shifts by one flush interval.
 
 Any store eviction or snapshot failure falls back to the oracle sweep for
 that round — consensus output is identical either way, and the node keeps
@@ -31,20 +39,44 @@ from babble_tpu.common.errors import StoreError
 logger = logging.getLogger("babble_tpu.hashgraph.accel")
 
 
+class _Inflight:
+    """A launched sweep whose output buffer a background thread is reading
+    back while gossip continues."""
+
+    __slots__ = ("win", "result", "error", "done", "generation", "t_launch",
+                 "t_done", "topo")
+
+    def __init__(self, win, generation: int, topo: int):
+        self.win = win
+        self.result = None  # (fame, rr) numpy arrays once read back
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.generation = generation
+        self.t_launch = time.perf_counter()
+        self.t_done = 0.0  # set by the reader when the readback lands
+        self.topo = topo  # hashgraph topological index at snapshot time
+
+
 class TensorConsensus:
     def __init__(self, sweep_events: int = 256, async_compile: bool = True,
-                 min_window: int | None = None):
+                 min_window: int | None = None,
+                 pipeline: bool | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
         self.sweep_events = sweep_events
         # Crossover threshold: below this many undetermined events the
-        # incremental oracle beats the sweep's fixed dispatch cost, so small
-        # windows stay on the host and the device takes over exactly when
-        # the oracle's O(witnesses² · rounds) voting would start to crawl.
-        # None = resolve on first use (lower on a real accelerator, higher
-        # on the CPU-XLA fallback). 0 forces the device path (tests).
+        # incremental oracle beats the sweep's fixed dispatch+readback cost,
+        # so small windows stay on the host and the device takes over
+        # exactly when the oracle's O(witnesses² · rounds) voting would
+        # start to crawl. None = resolve on first use. 0 forces the device
+        # path (tests).
         self.min_window = min_window
+        # Pipelined (non-blocking) sweeps: None = resolve on first flush —
+        # on a real accelerator the tunnel readback latency must be hidden;
+        # on the CPU-XLA fallback readback is free and synchronous sweeps
+        # keep decision latency minimal.
+        self.pipeline = pipeline
         # Compile window-shape buckets off the consensus thread: the first
         # sweep of a new bucket would otherwise stall gossip for the XLA
         # compile (seconds on CPU, tens of seconds cold on TPU) while
@@ -55,15 +87,23 @@ class TensorConsensus:
         self.fallbacks = 0
         self.compile_waits = 0
         self.small_windows = 0  # flushes routed to the oracle by min_window
+        self.deferred = 0  # flushes that rode behind an in-flight readback
+        self.generation = 0  # bumped by Hashgraph.reset/bootstrap
+        # A sweep whose readback exceeds this is abandoned (tunnel wedge):
+        # the oracle takes over so a dead device can stall only one sweep's
+        # worth of decisions, never the node.
+        self.readback_timeout_s = 30.0
+        self._last_snapshot_topo = -1
         self.last_sweep_s = 0.0
         self.total_sweep_s = 0.0
         self.last_window_events = 0
         # Per-stage rolling sums (seconds) for /debug and bench breakdowns.
-        self.stage_s = {"build": 0.0, "fame": 0.0, "apply": 0.0,
-                        "mask": 0.0, "rr": 0.0}
-        self._ready = set()
+        self.stage_s = {"build": 0.0, "kernel": 0.0, "apply": 0.0}
+        self._inflight: Optional[_Inflight] = None
         self._compiling = set()
         self._lock = threading.Lock()
+
+    # -- gates --------------------------------------------------------------
 
     def should_sweep(self, pending_inserts: int) -> bool:
         return pending_inserts >= self.sweep_events
@@ -73,27 +113,52 @@ class TensorConsensus:
         if self.min_window is None:
             import os
 
-            from babble_tpu.ops.device import is_cpu_fallback
+            from babble_tpu.ops.device import on_accelerator
 
             env = os.environ.get("BABBLE_ACCEL_MIN_WINDOW")
             if env is not None:
                 self.min_window = int(env)
             else:
-                self.min_window = 256 if is_cpu_fallback() else 64
+                self.min_window = 192 if on_accelerator() else 256
         if undetermined >= self.min_window:
             return True
         self.small_windows += 1
         return False
 
-    @staticmethod
-    def _bucket(win) -> tuple:
-        return (
-            win.n_witnesses,
-            win.n_events,
-            win.member.shape[1],
-            win.member.shape[0],
-            win.psi.shape[0],
-        )
+    def busy(self) -> bool:
+        """True while decisions are pending on an in-flight sweep — keeps
+        the node's fast heartbeat ticking so the next flush applies them."""
+        return self._inflight is not None
+
+    def invalidate(self) -> None:
+        """Drop any in-flight sweep (hashgraph reset / fast-sync landing):
+        its snapshot no longer describes this store."""
+        self.generation += 1
+        self._inflight = None
+        self._last_snapshot_topo = -1
+
+    # -- compile management -------------------------------------------------
+
+    def _bucket_ready(self, win) -> bool:
+        """True when the window's shape bucket is compiled. Otherwise kicks
+        a background compile (once) and returns False."""
+        from babble_tpu.ops import voting
+
+        if not self.async_compile:
+            return True  # compile inline (tests, explicit opt-out)
+        key = voting.bucket_key(win)
+        if voting.bucket_ready(key):
+            return True
+        with self._lock:
+            kick = key not in self._compiling
+            if kick:
+                self._compiling.add(key)
+        if kick:
+            threading.Thread(
+                target=self._compile_bucket, args=(key,), daemon=True
+            ).start()
+        self.compile_waits += 1
+        return False
 
     def _compile_bucket(self, key: tuple) -> None:
         from babble_tpu.ops import voting
@@ -106,8 +171,6 @@ class TensorConsensus:
                 key,
                 time.perf_counter() - t0,
             )
-            with self._lock:
-                self._ready.add(key)
         except Exception:
             # Leave the bucket un-ready so a later sweep retries the
             # background compile instead of stalling inline on it.
@@ -116,9 +179,65 @@ class TensorConsensus:
             with self._lock:
                 self._compiling.discard(key)
 
-    def sweep(self, hg) -> bool:
-        """One fame + round-received sweep. Returns False when the caller
-        must fall back to the oracle pipeline."""
+    # -- flush entry point ---------------------------------------------------
+
+    def flush(self, hg) -> bool:
+        """Handle one consensus flush. Returns False when the caller must
+        run the oracle voting stages instead."""
+        if self.pipeline is None:
+            from babble_tpu.ops.device import on_accelerator
+
+            self.pipeline = on_accelerator()
+        if not self.pipeline:
+            if not self.use_device(len(hg.undetermined_events)):
+                return False
+            return self.sweep(hg)
+
+        handled = False
+        inf = self._inflight
+        if inf is not None:
+            if inf.generation != self.generation:
+                self._inflight = None
+            elif not inf.done.is_set():
+                if (
+                    time.perf_counter() - inf.t_launch
+                    > self.readback_timeout_s
+                ):
+                    # Tunnel wedge: abandon the sweep (the reader thread
+                    # stays parked on the dead readback, harmless) and let
+                    # the oracle take over so the node keeps deciding.
+                    self._inflight = None
+                    self._note_fallback(
+                        TimeoutError(
+                            f"sweep readback exceeded "
+                            f"{self.readback_timeout_s:.0f}s"
+                        )
+                    )
+                    return False
+                # Results still crossing the tunnel; decisions arrive next
+                # flush. Skipping the oracle here is what hides the
+                # readback latency.
+                self.deferred += 1
+                return True
+            else:
+                self._inflight = None
+                if not self._apply(hg, inf):
+                    return False  # oracle carries this flush
+                handled = True
+        # Relaunch only when the DAG grew since the last snapshot: a sweep
+        # over an identical window returns identical decisions, so spinning
+        # launch/apply on a quiescent backlog would burn a device sweep per
+        # heartbeat for nothing and pin busy() high forever.
+        if hg.topological_index != self._last_snapshot_topo and self.use_device(
+            len(hg.undetermined_events)
+        ):
+            launched = self._launch(hg)
+            return handled or launched
+        return handled
+
+    # -- pipelined internals -------------------------------------------------
+
+    def _launch(self, hg) -> bool:
         from babble_tpu.ops import voting
 
         t0 = time.perf_counter()
@@ -126,58 +245,100 @@ class TensorConsensus:
             win = voting.build_voting_window(hg)
             if win is None:
                 return True  # nothing undecided
-            if self.async_compile:
-                key = self._bucket(win)
-                with self._lock:
-                    ready = key in self._ready
-                    kick = not ready and key not in self._compiling
-                    if kick:
-                        self._compiling.add(key)
-                if kick:
-                    threading.Thread(
-                        target=self._compile_bucket, args=(key,), daemon=True
-                    ).start()
-                if not ready:
-                    self.compile_waits += 1
-                    return False  # oracle carries this sweep
+            if not self._bucket_ready(win):
+                return False
+            out = voting.launch_sweep(win)
+        except Exception as err:
+            self._note_fallback(err)
+            return False
+        self.stage_s["build"] += time.perf_counter() - t0
+        inf = _Inflight(win, self.generation, hg.topological_index)
+        self._inflight = inf
+        self._last_snapshot_topo = hg.topological_index
+
+        def reader() -> None:
+            try:
+                inf.result = voting.read_sweep(out, inf.win)
+            except BaseException as e:  # device/tunnel failure
+                inf.error = e
+            finally:
+                inf.t_done = time.perf_counter()
+                inf.done.set()
+
+        threading.Thread(target=reader, daemon=True).start()
+        return True
+
+    def _apply(self, hg, inf: _Inflight) -> bool:
+        from babble_tpu.ops import voting
+
+        t0 = time.perf_counter()
+        if inf.error is not None:
+            self._note_fallback(inf.error)
+            return False
+        try:
+            fame, rr = inf.result
+            voting.apply_fame(hg, inf.win, fame)
+            voting.apply_round_received(hg, inf.win, rr)
+        except Exception as err:
+            self._note_fallback(err)
+            return False
+        t_apply = time.perf_counter() - t0
+        kernel_s = inf.t_done - inf.t_launch  # dispatch+kernel+readback
+        self.stage_s["apply"] += t_apply
+        self.stage_s["kernel"] += kernel_s
+        self.sweeps += 1
+        self.last_window_events = len(inf.win.hashes)
+        # Sweep cost, not launch-to-apply wall time (the latter includes
+        # the idle wait for this flush and would read as the flush
+        # interval in /stats).
+        self.last_sweep_s = kernel_s + t_apply
+        self.total_sweep_s += self.last_sweep_s
+        return True
+
+    # -- synchronous sweep ---------------------------------------------------
+
+    def sweep(self, hg) -> bool:
+        """One blocking fused sweep. Returns False when the caller must
+        fall back to the oracle pipeline."""
+        from babble_tpu.ops import voting
+
+        t0 = time.perf_counter()
+        try:
+            win = voting.build_voting_window(hg)
+            if win is None:
+                return True  # nothing undecided
+            if not self._bucket_ready(win):
+                return False
             t1 = time.perf_counter()
             self.stage_s["build"] += t1 - t0
-            see, fame = voting.run_fame(win)
+            fame, rr = voting.run_sweep(win)
             t2 = time.perf_counter()
-            self.stage_s["fame"] += t2 - t1
+            self.stage_s["kernel"] += t2 - t1
             voting.apply_fame(hg, win, fame)
-            t3 = time.perf_counter()
-            self.stage_s["apply"] += t3 - t2
-            decided, hard_block = voting.round_masks(hg, win)
-            t4 = time.perf_counter()
-            self.stage_s["mask"] += t4 - t3
-            if decided.any():
-                # Receiving requires a decided round; with none in the
-                # window the kernel would return all -1, so skip the call.
-                rr = voting.run_round_received(win, see, fame, decided,
-                                               hard_block)
-                t5 = time.perf_counter()
-                self.stage_s["rr"] += t5 - t4
-                voting.apply_round_received(hg, win, rr)
+            voting.apply_round_received(hg, win, rr)
+            self.stage_s["apply"] += time.perf_counter() - t2
         except Exception as err:
-            # Any failure — store eviction, a tunnel dropping mid-run, a
-            # device OOM — must degrade to the oracle, not kill the sync.
-            # Writebacks are ordered so no partial mutation precedes a
-            # fallible read (see apply_round_received), making the oracle
-            # re-run safe.
-            self.fallbacks += 1
-            if isinstance(err, StoreError):
-                logger.warning("accelerated sweep fell back to oracle: %s", err)
-            else:
-                logger.warning(
-                    "accelerated sweep fell back to oracle", exc_info=True
-                )
+            self._note_fallback(err)
             return False
         self.sweeps += 1
         self.last_window_events = len(win.hashes)
         self.last_sweep_s = time.perf_counter() - t0
         self.total_sweep_s += self.last_sweep_s
         return True
+
+    def _note_fallback(self, err: BaseException) -> None:
+        # Any failure — store eviction, a tunnel dropping mid-run, a device
+        # OOM — must degrade to the oracle, not kill the sync. Writebacks
+        # are ordered so no partial mutation precedes a fallible read (see
+        # apply_round_received), making the oracle re-run safe.
+        self.fallbacks += 1
+        if isinstance(err, StoreError):
+            logger.warning("accelerated sweep fell back to oracle: %s", err)
+        else:
+            logger.warning(
+                "accelerated sweep fell back to oracle",
+                exc_info=(type(err), err, err.__traceback__),
+            )
 
     def stats(self) -> dict:
         avg_ms = (
@@ -189,7 +350,9 @@ class TensorConsensus:
             "accel_fallbacks": self.fallbacks,
             "accel_compile_waits": self.compile_waits,
             "accel_small_windows": self.small_windows,
+            "accel_deferred": self.deferred,
             "accel_min_window": self.min_window,
+            "accel_pipeline": self.pipeline,
             "accel_last_sweep_ms": round(1000.0 * self.last_sweep_s, 3),
             "accel_avg_sweep_ms": round(avg_ms, 3),
             "accel_last_window_events": self.last_window_events,
@@ -197,3 +360,41 @@ class TensorConsensus:
                 k: round(1000.0 * v, 1) for k, v in self.stage_s.items()
             },
         }
+
+
+def prewarm_buckets(n_peers: int, background: bool = True):
+    """Compile (or load from the persistent XLA cache) the window-shape
+    buckets a freshly started node is most likely to hit, so the first
+    real backlog meets warm kernels instead of a compile wait. Called from
+    Node.init when --accelerator is on; runs in a daemon thread by default
+    (compiles happen in XLA's C++ with the GIL released)."""
+    from babble_tpu.ops import voting
+
+    P = voting._bucket_mult(n_peers, 8)
+    S = 1
+    buckets = [
+        (16, 32, P, S, 8),
+        (16, 64, P, S, 8),
+        (32, 128, P, S, 8),
+        (64, 256, P, S, 8),
+        (64, 256, P, S, 16),
+        (64, 512, P, S, 16),
+        (128, 512, P, S, 16),
+        (128, 1024, P, S, 16),
+    ]
+
+    def work() -> None:
+        for key in buckets:
+            if voting.bucket_ready(key):
+                continue
+            try:
+                voting.precompile(*key)
+            except Exception:
+                logger.warning("prewarm failed for %s", key, exc_info=True)
+
+    if background:
+        t = threading.Thread(target=work, daemon=True, name="voting-prewarm")
+        t.start()
+        return t
+    work()
+    return None

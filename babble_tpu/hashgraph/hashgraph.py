@@ -413,20 +413,19 @@ class Hashgraph:
 
     def flush_consensus(self) -> None:
         """Run any deferred accelerated consensus sweep (no-op without an
-        accelerator or pending inserts)."""
-        if self.accel is not None and self._accel_pending > 0:
+        accelerator; with one attached, also drains a pipelined sweep's
+        pending results even when nothing was inserted since)."""
+        if self.accel is not None and (
+            self._accel_pending > 0 or self.accel.busy()
+        ):
             self.run_consensus_sweep()
 
     def run_consensus_sweep(self) -> None:
         """One batched voting sweep: device kernels when the undecided
-        window is big enough to beat the dispatch cost, oracle stages
-        otherwise. Output is identical either way."""
+        window is big enough to beat the dispatch+readback cost, oracle
+        stages otherwise. Output is identical either way."""
         self._accel_pending = 0
-        if (
-            self.accel is not None
-            and self.accel.use_device(len(self.undetermined_events))
-            and self.accel.sweep(self)
-        ):
+        if self.accel is not None and self.accel.flush(self):
             self.process_decided_rounds()
             return
         self.decide_fame()
@@ -849,6 +848,9 @@ class Hashgraph:
         self.pending_loaded_events = 0
         self.topological_index = 0
         self._accel_pending = 0
+        if self.accel is not None:
+            # An in-flight sweep's snapshot no longer describes this store.
+            self.accel.invalidate()
 
         cs = self.store.cache_size()
         self._ancestor_cache = LRU(cs)

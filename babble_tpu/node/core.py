@@ -129,6 +129,7 @@ class Core:
             or len(self.transaction_pool) > 0
             or len(self.internal_transaction_pool) > 0
             or len(self.self_block_signatures) > 0
+            or (self.hg.accel is not None and self.hg.accel.busy())
             or (
                 self.hg.last_consensus_round is not None
                 and self.hg.last_consensus_round < self.target_round
@@ -167,12 +168,18 @@ class Core:
                 if decoded:
                     use_device_verify = self.accelerated_verify
                     if use_device_verify:
-                        # On the CPU-XLA fallback the limb kernel loses to
-                        # the native C++ verifier; the JAX path only pays
-                        # off on a real matrix unit.
-                        from babble_tpu.ops.device import is_cpu_fallback
+                        # Measured on the target: the device ladder kernel
+                        # costs ~590 ms per 64-signature tile through the
+                        # accelerator tunnel (dispatch/loop-bound) vs
+                        # ~100 us/sig for the native C++ verifier — the
+                        # device NEVER wins at gossip batch sizes, so the
+                        # sync path stays on the host unless explicitly
+                        # forced (benchmarking / future hardware).
+                        import os
 
-                        use_device_verify = not is_cpu_fallback()
+                        use_device_verify = (
+                            os.environ.get("BABBLE_DEVICE_VERIFY") == "1"
+                        )
                     if use_device_verify:
                         from babble_tpu.ops.verify import prevalidate_events
 

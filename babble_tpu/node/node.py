@@ -102,16 +102,25 @@ class Node(StateManager):
             # Resolve the device first: if the TPU link is down the probe
             # times out and the accelerated path runs on host XLA instead
             # of wedging the node at its first jax call.
+            import os
+
             from babble_tpu.ops.device import ensure_device, is_cpu_fallback
 
             ensure_device()
 
             if not is_cpu_fallback():
-                # Compile the batch-verify kernel before gossip starts so
-                # the first sync doesn't stall behind a ~15 s XLA compile.
-                # On the CPU fallback signature verification routes to the
-                # native C++ verifier instead (core.sync), so there is
-                # nothing to warm.
+                # Pre-warm the voting-sweep shape buckets a fresh node is
+                # likely to hit (background thread; XLA compiles with the
+                # GIL released, and the persistent compilation cache makes
+                # warm restarts near-instant). Without this the first real
+                # backlog meets a compile wait and the oracle carries it.
+                from babble_tpu.hashgraph.accel import prewarm_buckets
+
+                prewarm_buckets(len(self.core.peers.peers))
+            if os.environ.get("BABBLE_DEVICE_VERIFY") == "1":
+                # Device signature verification is opt-in (measured ~90x
+                # slower than the native verifier through the tunnel); when
+                # forced, compile its kernel before gossip starts.
                 from babble_tpu.ops.verify import warmup
 
                 warmup()

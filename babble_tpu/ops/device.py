@@ -34,6 +34,20 @@ def resolved() -> Optional[str]:
     return _resolved
 
 
+def on_accelerator() -> bool:
+    """True when jax dispatches to a real accelerator in this process —
+    resolved platform if a probe ran, else the actual default backend.
+    Drives the economics switches (pipelined sweeps, crossover windows):
+    on host XLA readback is free and synchronous sweeps win; through an
+    accelerator tunnel readback costs ~65-100 ms and must be pipelined."""
+    r = _resolved
+    if r is not None and r.split(",")[0] == "cpu":
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def is_cpu_fallback() -> bool:
     """True when the accelerated path is running on host XLA (resolved
     platform is cpu). Callers use this to route work where host XLA loses

@@ -28,17 +28,27 @@ and each round carries a peer-set slot (``psi``) selecting a membership mask
 and super-majority threshold (reference: per-round peer-sets in DecideFame,
 hashgraph.go:875-998, interval lookup caches.go:126-222).
 
-The sweep is split in two device calls with a host step between them because
-the oracle's round-decided flag is *sticky* (roundInfo.go:73-96): a round
-once decided stays decided even if a laggard later inserts an undecided
-witness into it. Fame comes off the device, the host applies it to the round
-infos (computing decidedness with the oracle's own sticky rule), and the
-round-received kernel then takes the per-round decided mask as an input. The
-``see`` mask stays on device between the two calls.
+The whole sweep — fame voting, per-round decidedness, and round-received —
+is ONE fused device call returning ONE concatenated int32 vector
+``[fame | round_received]``. This shape is forced by the measured transport
+economics of the target: a device→host readback of a fresh buffer costs
+~65-100 ms through the accelerator tunnel regardless of size, while kernel
+execution and host→device transfers are sub-millisecond. Any design with a
+host step in the middle (the round-3 two-call split) pays that latency twice
+and can never win; the fused kernel pays it once — and the async pipeline in
+:mod:`babble_tpu.hashgraph.accel` hides even that behind gossip.
 
-Shapes are padded to buckets (W and E to powers of two, R and P to multiples
-of 8, S to a power of two) so XLA compiles once per bucket and the jit cache
-stays warm across sweeps.
+The oracle's *sticky* round-decided flag (roundInfo.go:73-96; a round once
+decided stays decided even if a laggard later inserts an undecided witness)
+is preserved by passing the host's pre-sweep sticky flags in and computing
+post-sweep decidedness on device: fame decisions are monotone (the kernel
+only fills UNDEFINED slots), so device decidedness from (sticky | recompute
+over post-sweep fame) equals the oracle's post-apply ``witnesses_decided``.
+
+Shapes are padded to buckets (W, E, R and S to powers of two, P to a
+multiple of 8) so XLA compiles once per bucket and the jit cache stays warm
+across sweeps; compiled buckets are tracked module-wide so every node in a
+process shares warm-up work.
 """
 
 from __future__ import annotations
@@ -105,6 +115,10 @@ class VotingWindow:
     sm_s: np.ndarray  # [S] int32 super-majority per slot
     psi: np.ndarray  # [R] int32 rebased-round -> peer-set slot
     sm_r: np.ndarray  # [R] int32 rebased-round -> super-majority
+    # round-scan state for the fused decided/hard-block computation
+    exists_r: np.ndarray  # [R] bool — round info readable from the store
+    prior_dec_r: np.ndarray  # [R] bool — pre-sweep sticky decided flags
+    lb_gate_r: np.ndarray  # [R] bool — round above the fast-sync lower bound
     base: int  # absolute round of rebased round 0
     hashes: List[str] = field(default_factory=list)  # real E rows
     row: Dict[str, int] = field(default_factory=dict)
@@ -212,18 +226,48 @@ def _rr_core(see_we, rounds_w, valid_w, fame_w, rounds_e, undet_e,
     return rr
 
 
+def _sweep_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
+                wit_idx, member, sm_s, psi, sm_r,
+                rounds_e, undet_e, exists_r, prior_dec_r, lb_gate_r):
+    """The fused sweep: fame voting → per-round decidedness → round-received
+    in one compiled program, one output buffer, one readback.
+
+    Decidedness replicates ``RoundInfo.witnesses_decided``
+    (roundInfo.go:78-96) on device: a round is decided when no witness is
+    UNDEFINED and the decided count reaches the round's super-majority —
+    OR the host's sticky pre-sweep flag was already set. Hard-blocking
+    replicates the oracle's receive-scan stops (hashgraph.go:1019-1046):
+    an unreadable round blocks unconditionally; an undecided round blocks
+    only above the fast-sync lower bound.
+    """
+    see_we, fame = _fame_core(
+        creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
+        wit_idx, member, sm_s, psi, sm_r,
+    )
+    R = psi.shape[0]
+    r_ax = jnp.arange(R)
+    m_rw = valid_w[None, :] & (rounds_w[None, :] == r_ax[:, None])  # [R, W]
+    undecided_w = fame == 0
+    has_undec = jnp.any(m_rw & undecided_w[None, :], axis=1)
+    cnt = jnp.sum(m_rw & (~undecided_w)[None, :], axis=1, dtype=jnp.int32)
+    decided_r = prior_dec_r | (exists_r & ~has_undec & (cnt >= sm_r))
+    hard_block_r = (~exists_r) | ((~decided_r) & lb_gate_r)
+    rr = _rr_core(see_we, rounds_w, valid_w, fame, rounds_e, undet_e,
+                  decided_r, hard_block_r, sm_r)
+    return jnp.concatenate([fame, rr])
+
+
 # Counts traces so tests can pin the compile-cache property.
 _trace_count = 0
 
 
-def _counting_fame(*args):
+def _counting_sweep(*args):
     global _trace_count
     _trace_count += 1
-    return _fame_core(*args)
+    return _sweep_core(*args)
 
 
-_fame_jit = jax.jit(_counting_fame)
-_rr_jit = jax.jit(_rr_core)
+_sweep_jit = jax.jit(_counting_sweep)
 
 
 # =============================================================================
@@ -301,7 +345,7 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
     W = _bucket_pow2(W_real, 16)
     P = _bucket_mult(n_peers, 8)
     R_real = last_round - base + 2
-    R = _bucket_mult(R_real, 8)
+    R = _bucket_pow2(R_real, 8)
 
     creator = np.zeros(E, np.int32)
     index = np.full(E, -1, np.int32)
@@ -352,8 +396,21 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
     sms: List[int] = []
     psi = np.zeros(R, np.int32)
     sm_r = np.full(R, 2**30, np.int32)
+    exists_r = np.zeros(R, bool)
+    prior_dec_r = np.zeros(R, bool)
+    lb_gate_r = np.zeros(R, bool)
+    lb = hg.round_lower_bound
     for r in range(R):
-        ps = store.get_peer_set(base + r)
+        a = base + r
+        lb_gate_r[r] = lb is None or lb < a
+        try:
+            ri = store.get_round(a)
+        except StoreError:
+            pass  # exists_r stays False -> hard-blocks the receive scan
+        else:
+            exists_r[r] = True
+            prior_dec_r[r] = ri.decided
+        ps = store.get_peer_set(a)
         key = ps.hash()
         s = slot_of.get(key)
         if s is None:
@@ -392,6 +449,9 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
         sm_s=sm_s,
         psi=psi,
         sm_r=sm_r,
+        exists_r=exists_r,
+        prior_dec_r=prior_dec_r,
+        lb_gate_r=lb_gate_r,
         base=base,
         hashes=hashes,
         row=rows,
@@ -400,11 +460,46 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
     )
 
 
+def bucket_key(win: VotingWindow) -> tuple:
+    return (
+        win.n_witnesses,
+        win.n_events,
+        win.member.shape[1],
+        win.member.shape[0],
+        win.psi.shape[0],
+    )
+
+
+# Compiled-bucket bookkeeping shared by every TensorConsensus in the process
+# (the underlying jit cache is global, so warm-up work must be too).
+_ready_buckets: set = set()
+_ready_lock = None  # created lazily to keep import cheap
+
+
+def _bucket_lock():
+    global _ready_lock
+    if _ready_lock is None:
+        import threading
+
+        _ready_lock = threading.Lock()
+    return _ready_lock
+
+
+def bucket_ready(key: tuple) -> bool:
+    with _bucket_lock():
+        return key in _ready_buckets
+
+
+def mark_bucket_ready(key: tuple) -> None:
+    with _bucket_lock():
+        _ready_buckets.add(key)
+
+
 def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
-    """Compile (or load from the persistent cache) both kernels for a shape
-    bucket by running them on an all-invalid dummy window. Called from a
-    background thread by TensorConsensus so live sweeps never stall on XLA
-    compilation."""
+    """Compile (or load from the persistent cache) the fused sweep kernel
+    for a shape bucket by running it on an all-invalid dummy window. Called
+    from a background thread (TensorConsensus / node prewarm) so live
+    sweeps never stall on XLA compilation."""
     win = VotingWindow(
         creator=np.zeros(E, np.int32),
         index=np.full(E, -1, np.int32),
@@ -421,15 +516,21 @@ def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
         sm_s=np.full(S, 2**30, np.int32),
         psi=np.zeros(R, np.int32),
         sm_r=np.full(R, 2**30, np.int32),
+        exists_r=np.zeros(R, bool),
+        prior_dec_r=np.zeros(R, bool),
+        lb_gate_r=np.zeros(R, bool),
         base=0,
     )
-    see, fame = run_fame(win)
-    run_round_received(win, see, fame, np.zeros(R, bool), np.zeros(R, bool))
+    run_sweep(win)
+    mark_bucket_ready((W, E, P, S, R))
 
 
-def run_fame(win: VotingWindow):
-    """Device call 1: virtual voting. Returns (see_device, fame_host)."""
-    see, fame = _fame_jit(
+def launch_sweep(win: VotingWindow):
+    """Dispatch the fused sweep. Returns the device output buffer WITHOUT
+    reading it back — dispatch is sub-millisecond; the ~65-100 ms tunnel
+    readback is paid by read_sweep (on a background thread in the node's
+    pipelined mode)."""
+    return _sweep_jit(
         jnp.asarray(win.creator),
         jnp.asarray(win.index),
         jnp.asarray(win.la_w),
@@ -443,28 +544,25 @@ def run_fame(win: VotingWindow):
         jnp.asarray(win.sm_s),
         jnp.asarray(win.psi),
         jnp.asarray(win.sm_r),
-    )
-    return see, np.asarray(fame)
-
-
-def run_round_received(win: VotingWindow, see, fame: np.ndarray,
-                       decided_r: np.ndarray,
-                       hard_block_r: np.ndarray) -> np.ndarray:
-    """Device call 2: round-received, given the host-stamped sticky
-    per-round masks from round_masks. ``see`` is the [W, E] device array
-    from run_fame."""
-    rr = _rr_jit(
-        see,
-        jnp.asarray(win.rounds_w),
-        jnp.asarray(win.valid_w),
-        jnp.asarray(fame),
         jnp.asarray(win.rounds),
         jnp.asarray(win.undet),
-        jnp.asarray(decided_r),
-        jnp.asarray(hard_block_r),
-        jnp.asarray(win.sm_r),
+        jnp.asarray(win.exists_r),
+        jnp.asarray(win.prior_dec_r),
+        jnp.asarray(win.lb_gate_r),
     )
-    return np.asarray(rr)
+
+
+def read_sweep(out, win: VotingWindow):
+    """One readback of the concatenated [fame | round_received] vector,
+    split into (fame[W], rr[E]) numpy arrays."""
+    host = np.asarray(out)
+    W = win.n_witnesses
+    return host[:W], host[W:W + win.n_events]
+
+
+def run_sweep(win: VotingWindow):
+    """Synchronous fused sweep: dispatch + single readback."""
+    return read_sweep(launch_sweep(win), win)
 
 
 def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
@@ -493,37 +591,6 @@ def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
         store.set_round(pr.index, ri)
     hg.pending_rounds.update(decided_rounds)
     return decided_rounds
-
-
-def round_masks(hg, win: VotingWindow):
-    """(decided, hard_block) masks over the window's (rebased) round axis,
-    computed AFTER apply_fame so this sweep's decisions are visible, with
-    the oracle's exact scan-stopping semantics (hashgraph.go:1019-1046):
-
-    - a round with no info (evicted or never created) HARD-BLOCKS the scan
-      unconditionally — the oracle breaks on the StoreError;
-    - an undecided round hard-blocks only above the fast-sync lower bound;
-      at or below it the oracle `continue`s past the round.
-
-    ``witnesses_decided`` uses the oracle's own sticky rule, so a round
-    that decided before a laggard's late witness arrived stays decided.
-    """
-    R = win.psi.shape[0]
-    decided = np.zeros(R, bool)
-    hard_block = np.zeros(R, bool)
-    lb = hg.round_lower_bound
-    for r in range(R):
-        a = win.base + r
-        try:
-            ri = hg.store.get_round(a)
-            ps = hg.store.get_peer_set(a)
-        except StoreError:
-            hard_block[r] = True
-            continue
-        decided[r] = ri.witnesses_decided(ps)
-        if not decided[r] and (lb is None or lb < a):
-            hard_block[r] = True
-    return decided, hard_block
 
 
 def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:

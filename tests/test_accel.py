@@ -118,6 +118,43 @@ def test_accel_matches_oracle(graph, sweep_events):
     assert a_undet == o_undet
 
 
+@pytest.mark.parametrize("graph", list(BUILDERS))
+def test_accel_pipelined_matches_oracle(graph):
+    """The non-blocking pipelined mode (the real-accelerator default, where
+    flushes apply the PREVIOUS sweep's results while the next computes)
+    must converge to the oracle's exact consensus state. Forced on the CPU
+    mesh here; each insert's flush may defer, so drain at the end."""
+    h, index, nodes, peer_set = BUILDERS[graph]()
+    ordered = _ordered_events(h)
+    oracle = _replay(ordered, peer_set)
+
+    hp = Hashgraph(InmemStore(1000))
+    hp.init(peer_set)
+    hp.accel = TensorConsensus(sweep_events=3, async_compile=False,
+                               min_window=0, pipeline=True)
+    for ev in ordered:
+        hp.insert_event_and_run_consensus(Event(ev.body, ev.signature),
+                                          set_wire_info=True)
+    # Drain: each flush applies one in-flight sweep and may launch another;
+    # stop when nothing is in flight and the state has stopped changing.
+    prev = None
+    for _ in range(200):
+        inf = hp.accel._inflight
+        if inf is not None:
+            inf.done.wait(10.0)
+        hp._accel_pending = max(hp._accel_pending, 1)
+        hp.flush_consensus()
+        if hp.accel.busy():
+            continue
+        cur = _consensus_state(hp)
+        if cur == prev:
+            break
+        prev = cur
+    assert hp.accel.sweeps > 0
+    assert hp.accel.fallbacks == 0
+    assert _consensus_state(hp) == _consensus_state(oracle)
+
+
 def _ordered_events(h: Hashgraph):
     store = h.store
     events = []
