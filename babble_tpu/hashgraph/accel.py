@@ -184,6 +184,11 @@ class TensorConsensus:
     def flush(self, hg) -> bool:
         """Handle one consensus flush. Returns False when the caller must
         run the oracle voting stages instead."""
+        from babble_tpu.ops.device import jax_usable
+
+        if not jax_usable():
+            # Wedged device link: importing jax would hang the node.
+            return False
         if self.pipeline is None:
             from babble_tpu.ops.device import on_accelerator
 

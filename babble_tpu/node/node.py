@@ -89,6 +89,7 @@ class Node(StateManager):
         # the same ns durations per round, node.go:511-514,543-548,593-608).
         self.timers = LatencyRecorder()
         self.initial_undetermined_events = 0
+        self._prewarm_thread = None
         # Cap overlapping gossip rounds: unbounded overlap just piles
         # threads onto core_lock under the GIL (the Go reference relies on
         # cheap goroutines; here 2 in flight keeps the pipeline full).
@@ -114,10 +115,22 @@ class Node(StateManager):
                 # GIL released, and the persistent compilation cache makes
                 # warm restarts near-instant). Without this the first real
                 # backlog meets a compile wait and the oracle carries it.
+                # BABBLE_PREWARM_BLOCK=1 makes init wait for the warm-up
+                # (bench harnesses: compiles tracing in Python would
+                # otherwise contend with the measured gossip).
                 from babble_tpu.hashgraph.accel import prewarm_buckets
 
-                prewarm_buckets(len(self.core.peers.peers))
-            if os.environ.get("BABBLE_DEVICE_VERIFY") == "1":
+                self._prewarm_thread = prewarm_buckets(
+                    len(self.core.peers.peers)
+                )
+                if (
+                    os.environ.get("BABBLE_PREWARM_BLOCK") == "1"
+                    and self._prewarm_thread is not None
+                ):
+                    self._prewarm_thread.join(timeout=300.0)
+            from babble_tpu.ops.device import jax_usable
+
+            if os.environ.get("BABBLE_DEVICE_VERIFY") == "1" and jax_usable():
                 # Device signature verification is opt-in (measured ~90x
                 # slower than the native verifier through the tunnel); when
                 # forced, compile its kernel before gossip starts.

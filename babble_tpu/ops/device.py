@@ -1,17 +1,23 @@
 """Device resolution for live nodes: probe the accelerator, fall back to CPU.
 
-Under the axon tunnel, ``jax.devices()`` blocks indefinitely when the TPU
-link is down (observed as the round-2 bench's "device tunnel timeout"). A
-node started with ``--accelerator`` must not wedge on that, so before any
-in-process jax backend initialization we probe the configured platform in a
-throwaway subprocess with a timeout; on failure this process is switched to
-the CPU backend — the same kernels run, just on host XLA — and the node
-keeps its accelerated code path.
+Under the axon tunnel, ``import jax`` itself can block indefinitely when the
+TPU link is down or wedged (the site hook registers the PJRT plugin at
+interpreter start; backend discovery then waits on the dead link — observed
+as the round-2 bench's "device tunnel timeout" and reproduced in round 4 by
+killing a bench mid-run). A node started with ``--accelerator`` must not
+wedge on that, so the health of the configured platform is decided in a
+throwaway SUBPROCESS with a timeout, BEFORE this process ever imports jax:
+
+- probe succeeds      -> use the configured platform;
+- probe fails quickly -> the platform errors cleanly; this process imports
+  jax and runs the same kernels on host XLA ("cpu" fallback);
+- probe TIMES OUT     -> the link is wedged and any jax import would hang;
+  the device is marked DEAD and nothing in this process may import jax —
+  the oracle carries consensus (``jax_usable()`` gates every jax path).
 
 Also installs the persistent XLA compilation cache for live processes (the
-test conftest does this only for pytest runs): the secp256k1 ladder kernel
-takes ~15 s to compile per batch bucket, and the voting kernels compile per
-window-shape bucket, so warm restarts matter.
+test conftest does this only for pytest runs): voting kernels compile per
+window-shape bucket (seconds each), so warm restarts matter.
 """
 
 from __future__ import annotations
@@ -28,10 +34,19 @@ logger = logging.getLogger("babble_tpu.ops.device")
 _lock = threading.Lock()
 _resolved: Optional[str] = None
 
+#: sentinel platform value: the link is wedged; importing jax would hang.
+DEAD = "dead"
+
 
 def resolved() -> Optional[str]:
     """The platform ensure_device() settled on, or None before any probe."""
     return _resolved
+
+
+def jax_usable() -> bool:
+    """False when importing jax in this process would hang (wedged tunnel).
+    Every accelerated code path must check this before touching jax."""
+    return _resolved != DEAD
 
 
 def on_accelerator() -> bool:
@@ -41,8 +56,11 @@ def on_accelerator() -> bool:
     on host XLA readback is free and synchronous sweeps win; through an
     accelerator tunnel readback costs ~65-100 ms and must be pipelined."""
     r = _resolved
-    if r is not None and r.split(",")[0] == "cpu":
+    if r is not None and r.split(",")[0] in ("", "cpu", DEAD):
         return False
+    if r is not None and r.split(",")[0] != "default":
+        return True
+    # unresolved, or resolved to "default": ask the actual backend
     import jax
 
     return jax.default_backend() != "cpu"
@@ -50,12 +68,13 @@ def on_accelerator() -> bool:
 
 def is_cpu_fallback() -> bool:
     """True when the accelerated path is running on host XLA (resolved
-    platform is cpu). Callers use this to route work where host XLA loses
-    to native host code — e.g. signature verification goes to the C++
-    batch verifier instead of the JAX limb kernel, whose only advantage is
-    a real matrix unit."""
+    platform is cpu) or the device is dead. Callers use this to route work
+    where host XLA loses to native host code — e.g. signature verification
+    goes to the C++ batch verifier instead of the JAX limb kernel, whose
+    only advantage is a real matrix unit."""
     r = _resolved
-    return r is not None and r.split(",")[0] == "cpu"
+    return r is not None and r.split(",")[0] in ("cpu", DEAD)
+
 
 PROBE_TIMEOUT_S = float(os.environ.get("BABBLE_DEVICE_PROBE_TIMEOUT", "60"))
 
@@ -76,25 +95,34 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
     """Resolve the jax platform once per process, before any backend init.
 
     Returns the platform this process will use ("cpu", the configured
-    platform, or "default"). Thread-safe; the probe runs at most once.
+    platform, "default", or DEAD). Thread-safe; the probe runs at most
+    once. jax is imported in-process only when that is known to be safe.
     """
     global _resolved
     with _lock:
         if _resolved is not None:
             return _resolved
-        import jax
 
-        _setup_compile_cache(jax)
+        target = os.environ.get("JAX_PLATFORMS", "")
+        if "jax" in sys.modules:
+            # jax already imported (and so already survived backend
+            # discovery); respect any config-level platform override.
+            import jax
 
-        cfg = jax.config.jax_platforms  # set by conftest or earlier callers
-        target = cfg or os.environ.get("JAX_PLATFORMS", "")
+            target = jax.config.jax_platforms or target
         # Only the FIRST platform matters: "axon,cpu" initializes axon and
         # blocks on a dead tunnel despite the cpu entry behind it.
         preferred = target.split(",")[0] if target else ""
-        if preferred in ("", "cpu"):
-            _resolved = target or "default"
+        if preferred == "cpu" and "jax" in sys.modules:
+            # CPU explicitly pinned and the import already survived (test
+            # conftest): nothing to probe.
+            import jax
+
+            _setup_compile_cache(jax)
+            _resolved = target
             return _resolved
 
+        timed_out = False
         try:
             # The child only inherits os.environ, so pin the platform there
             # in case it was configured via jax.config in this process.
@@ -102,20 +130,37 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=timeout_s,
                 capture_output=True,
-                env={**os.environ, "JAX_PLATFORMS": target},
+                env={**os.environ, "JAX_PLATFORMS": target or ""},
             )
             ok = proc.returncode == 0
         except subprocess.TimeoutExpired:
             ok = False
+            timed_out = True
+
         if ok:
-            _resolved = target
+            _resolved = target or "default"
+        elif timed_out and "jax" not in sys.modules:
+            # Wedged link: importing jax here would hang this process too.
+            logger.warning(
+                "jax backend init hung past %.0fs (wedged device link); "
+                "marking the device DEAD — the oracle carries consensus",
+                timeout_s,
+            )
+            _resolved = DEAD
+            return _resolved
         else:
             logger.warning(
-                "platform %r unreachable (probe timeout %.0fs); "
+                "platform %r unreachable (probe failed, timeout %.0fs); "
                 "falling back to CPU XLA for the accelerated path",
                 target,
                 timeout_s,
             )
-            jax.config.update("jax_platforms", "cpu")
             _resolved = "cpu"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
+        import jax
+
+        if _resolved == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        _setup_compile_cache(jax)
         return _resolved

@@ -119,6 +119,10 @@ def bench_gossip(
         ]
     )
     addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    if accelerator:
+        # Node startup completes before load: kernel prewarm compiles trace
+        # in Python and would otherwise contend with the measured gossip.
+        os.environ["BABBLE_PREWARM_BLOCK"] = "1"
     nodes, proxies, states = [], [], []
     for i, k in enumerate(keys):
         conf = Config(
@@ -186,16 +190,23 @@ def bench_gossip(
         "latency_samples": n_lat,
     }
     if accelerator:
-        s = nodes[0].get_stats()
+        stats = [n.get_stats() for n in nodes]
+        # node with the most device activity is representative
+        best = max(stats, key=lambda s: int(s.get("accel_sweeps") or 0))
         for key in (
             "accel_sweeps",
             "accel_fallbacks",
             "accel_compile_waits",
+            "accel_small_windows",
+            "accel_deferred",
             "accel_avg_sweep_ms",
             "accel_last_window_events",
             "accel_stage_ms",
         ):
-            out[key] = s.get(key)
+            if key in ("accel_sweeps", "accel_fallbacks"):
+                out[key] = sum(int(s.get(key) or 0) for s in stats)
+            else:
+                out[key] = best.get(key)
     for n in nodes:
         n.shutdown()
     return out
@@ -284,7 +295,8 @@ def bench_dag_pipeline_guarded():
     return None, None, None, None, None, reason
 
 
-def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02):
+def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02,
+                      accelerator: bool = False):
     """Full nodes over localhost TCP (BASELINE.md config 3 topology)."""
     from babble_tpu.config.config import Config
     from babble_tpu.crypto.keys import generate_key
@@ -312,6 +324,7 @@ def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02):
             slow_heartbeat_timeout=0.3,
             log_level="error",
             moniker=f"t{i}",
+            accelerator=accelerator,
         )
         st = DummyState()
         pr = InmemProxy(st)
@@ -539,6 +552,173 @@ def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _synthetic_stream(n_peers: int, n_events: int, seed: int = 1):
+    """A deterministic random-gossip event stream: each event's self-parent
+    is its creator's head, other-parent a random peer's head — the same
+    DAG shape live gossip produces, at controllable scale."""
+    import random
+
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.hashgraph import Event
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+
+    rng = random.Random(seed)
+    keys = [generate_key() for _ in range(n_peers)]
+    peers = PeerSet(
+        [
+            Peer(f"inmem://p{i}", k.public_key.hex(), f"p{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    heads = [""] * n_peers
+    seqs = [-1] * n_peers
+    events = []
+    order = list(range(n_peers))
+    while len(events) < n_events:
+        rng.shuffle(order)
+        for i in order:
+            if len(events) >= n_events:
+                break
+            op = ""
+            if events:
+                j = rng.randrange(n_peers - 1)
+                j = j if j < i else j + 1
+                op = heads[j]
+                if op == "":
+                    continue
+            idx = seqs[i] + 1
+            e = Event.new(
+                [b"t"] if idx else [], [], [], [heads[i], op],
+                keys[i].public_key.bytes(), idx, timestamp=len(events),
+            )
+            e.sign(keys[i])
+            heads[i] = e.hex()
+            seqs[i] = idx
+            events.append(e)
+    return events, peers
+
+
+def _replay_inserts(events, peers, accel=None):
+    """Insert + divide_rounds only (voting deferred), signatures pre-passed
+    so the sweep comparison isolates the voting stages."""
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+
+    h = Hashgraph(InmemStore(100000))
+    h.init(peers)
+    if accel is not None:
+        h.accel = accel
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        h.insert_event(e, set_wire_info=True)
+        h.divide_rounds()
+    return h
+
+
+def bench_crossover():
+    """Oracle-vs-device cost of ONE voting sweep (DecideFame +
+    DecideRoundReceived + ProcessDecidedRounds) as the undecided window
+    grows — the measured crossover behind the accelerator's min_window
+    gate. ``pipelined_loop_ms`` is what the gossip loop actually pays per
+    flush in the non-blocking device mode (snapshot build + result apply;
+    the kernel+readback hides behind gossip on a background thread).
+
+    Returns (rows, crossover_E): rows of
+    {peers, events, oracle_ms, device_ms, pipelined_loop_ms}."""
+    from babble_tpu.hashgraph.accel import TensorConsensus
+    from babble_tpu.ops import voting
+    from babble_tpu.ops.device import ensure_device, jax_usable
+
+    ensure_device()
+    if not jax_usable():
+        raise RuntimeError("device link wedged; skipping crossover")
+
+    rows = []
+    crossover = None
+    for n_peers, n_events in [
+        (16, 1024), (16, 2048), (32, 2048), (32, 4096),
+    ]:
+        events, peers = _synthetic_stream(n_peers, n_events)
+        # oracle sweep
+        h = _replay_inserts(events, peers)
+        t0 = time.perf_counter()
+        h.decide_fame()
+        h.decide_round_received()
+        h.process_decided_rounds()
+        t_oracle = time.perf_counter() - t0
+        # device sweep: compile (or load from the persistent cache) the
+        # window's exact shape bucket first, then measure warm
+        acc = TensorConsensus(sweep_events=10**9, async_compile=False,
+                              min_window=0, pipeline=False)
+        hd = _replay_inserts(events, peers, acc)
+        win = voting.build_voting_window(hd)
+        voting.precompile(*voting.bucket_key(win))
+        hd._accel_pending = 1
+        t0 = time.perf_counter()
+        hd.run_consensus_sweep()
+        t_device = time.perf_counter() - t0
+        ok = (
+            acc.fallbacks == 0
+            and hd.store.last_block_index() == h.store.last_block_index()
+        )
+        # pipelined loop cost = build + apply (readback rides a bg thread)
+        loop_ms = 1e3 * (acc.stage_s["build"] + acc.stage_s["apply"])
+        rows.append({
+            "peers": n_peers,
+            "events": n_events,
+            "oracle_ms": round(1e3 * t_oracle, 1),
+            "device_ms": round(1e3 * t_device, 1),
+            "pipelined_loop_ms": round(loop_ms, 1),
+            "consensus_match": ok,
+        })
+        if crossover is None and t_device < t_oracle:
+            crossover = f"P={n_peers},E={n_events}"
+    return rows, crossover
+
+
+def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
+    """Config 3 (threaded): 16 full TCP nodes in one process, oracle vs
+    accelerated. The GIL serializes all nodes, but at 16 validators the
+    undecided windows are finally big enough for device sweeps to engage —
+    this is the live-cluster engagement proof for the crossover table.
+    Returns (txs_per_s, accel_stats_of_busiest_node_or_None)."""
+    if accelerator:
+        os.environ["BABBLE_PREWARM_BLOCK"] = "1"
+    nodes, proxies, states = _make_tcp_cluster(
+        16, 28700 if accelerator else 28100, heartbeat=0.05,
+        accelerator=accelerator,
+    )
+    try:
+        rate = _measure(nodes, proxies, states, window_s, warmup_s=8.0)
+        stats = None
+        if accelerator:
+            all_stats = [n.get_stats() for n in nodes]
+            busiest = max(
+                all_stats, key=lambda s: int(s.get("accel_sweeps") or 0)
+            )
+            stats = {
+                "accel_sweeps_total": sum(
+                    int(s.get("accel_sweeps") or 0) for s in all_stats
+                ),
+                "accel_fallbacks_total": sum(
+                    int(s.get("accel_fallbacks") or 0) for s in all_stats
+                ),
+                "busiest_node": {
+                    k: busiest.get(k)
+                    for k in (
+                        "accel_sweeps", "accel_avg_sweep_ms",
+                        "accel_last_window_events", "accel_compile_waits",
+                        "accel_small_windows",
+                    )
+                },
+            }
+        return rate, stats
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
 def bench_churn(window_s: float = 20.0):
     """Config 4: 4-node TCP cluster with a node joining and leaving under
     load (dynamic membership churn)."""
@@ -705,6 +885,44 @@ def main() -> None:
         accel = {"error": f"{type(err).__name__}: {err}"}
         print(f"accelerated bench failed: {err}", file=sys.stderr)
 
+    # Oracle-vs-device sweep crossover (the economics behind min_window).
+    try:
+        crossover_rows, crossover_at = bench_crossover()
+        for row in crossover_rows:
+            print(
+                f"sweep P={row['peers']:3d} E={row['events']:5d}: "
+                f"oracle={row['oracle_ms']:7.1f}ms "
+                f"device={row['device_ms']:7.1f}ms "
+                f"pipelined-loop={row['pipelined_loop_ms']:5.1f}ms "
+                f"match={row['consensus_match']}",
+                file=sys.stderr,
+            )
+        print(f"device wins from: {crossover_at}", file=sys.stderr)
+        crossover = {"rows": crossover_rows, "device_wins_from": crossover_at}
+    except Exception as err:
+        crossover = {"error": f"{type(err).__name__}: {err}"}
+        print(f"crossover bench failed: {err}", file=sys.stderr)
+
+    # Config 3 (threaded 16-node), oracle vs accelerated (sweep engagement
+    # in a live cluster).
+    config3_threads = {}
+    for label, acc16 in (("oracle", False), ("accelerated", True)):
+        try:
+            rate16, stats16 = bench_16node_threads(accelerator=acc16)
+            config3_threads[label] = {"txs_per_s": round(rate16, 1)}
+            if stats16:
+                config3_threads[label].update(stats16)
+            print(
+                f"16-node threads {label}: {rate16:.1f} tx/s"
+                + (f" sweeps={stats16['accel_sweeps_total']}"
+                   f" fallbacks={stats16['accel_fallbacks_total']}"
+                   if stats16 else ""),
+                file=sys.stderr,
+            )
+        except Exception as err:
+            config3_threads[label] = {"error": f"{type(err).__name__}: {err}"}
+            print(f"16-node threads {label} failed: {err}", file=sys.stderr)
+
     # Process-per-node comparison: in-process clusters serialize all nodes
     # on one GIL, so this is the honest per-node view of the device path.
     procs = {}
@@ -728,6 +946,47 @@ def main() -> None:
             procs[label] = {"error": f"{type(err).__name__}: {err}"}
             print(f"subprocess {label} bench failed: {err}", file=sys.stderr)
 
+    # Configs 3-5 captured every round (time-budgeted).
+    config3_procs = {}
+    try:
+        r3, p50_3, p95_3 = bench_subprocess_cluster(window_s=15.0)
+        config3_procs = {
+            "txs_per_s": round(r3, 1),
+            "latency_p50_ms": p50_3,
+            "latency_p95_ms": p95_3,
+        }
+        print(
+            f"config 3 (16 subprocess nodes): {r3:.1f} tx/s p50={p50_3}ms",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        config3_procs = {"error": f"{type(err).__name__}: {err}"}
+        print(f"config 3 subprocess failed: {err}", file=sys.stderr)
+    config4 = {}
+    try:
+        r4, churn = bench_churn(window_s=12.0)
+        config4 = {"txs_per_s": round(r4, 1), "churn_events": churn}
+        print(f"config 4 (churn): {r4:.1f} tx/s {churn}", file=sys.stderr)
+    except Exception as err:
+        config4 = {"error": f"{type(err).__name__}: {err}"}
+        print(f"config 4 churn failed: {err}", file=sys.stderr)
+    config5 = {}
+    try:
+        r5, flooded, junk = bench_adversarial(window_s=8.0)
+        config5 = {
+            "txs_per_s": round(r5, 1),
+            "bad_sigs_flooded": flooded,
+            "junk_accepted": junk,
+        }
+        print(
+            f"config 5 (bad-sig flood): {r5:.1f} tx/s honest, "
+            f"{flooded} junk sent, {junk} accepted",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        config5 = {"error": f"{type(err).__name__}: {err}"}
+        print(f"config 5 adversarial failed: {err}", file=sys.stderr)
+
     eps, dag_dt, device, dag_E, mfu, dag_err = bench_dag_pipeline_guarded()
 
     extra = {
@@ -737,6 +996,11 @@ def main() -> None:
         "latency_p50_ms": oracle["latency_p50_ms"],
         "latency_p95_ms": oracle["latency_p95_ms"],
         "accelerated_4node": accel,
+        "sweep_crossover": crossover,
+        "config3_16node_threads": config3_threads,
+        "config3_16node_procs": config3_procs,
+        "config4_churn": config4,
+        "config5_adversarial": config5,
         "subprocess_4node": procs,
         "baseline_note": "reference CI liveness floor ~333 tx/s "
         "(node_test.go:536-631); reference publishes no numbers",

@@ -118,6 +118,25 @@ def test_accel_matches_oracle(graph, sweep_events):
     assert a_undet == o_undet
 
 
+def drain_pipelined(hg, max_iters: int = 200) -> None:
+    """Flush a pipelined-accelerator hashgraph until nothing is in flight
+    and the consensus state has stopped changing: each flush applies one
+    in-flight sweep's results and may launch another."""
+    prev = None
+    for _ in range(max_iters):
+        inf = hg.accel._inflight
+        if inf is not None:
+            inf.done.wait(10.0)
+        hg._accel_pending = max(hg._accel_pending, 1)
+        hg.flush_consensus()
+        if hg.accel.busy():
+            continue
+        cur = _consensus_state(hg)
+        if cur == prev:
+            return
+        prev = cur
+
+
 @pytest.mark.parametrize("graph", list(BUILDERS))
 def test_accel_pipelined_matches_oracle(graph):
     """The non-blocking pipelined mode (the real-accelerator default, where
@@ -135,21 +154,7 @@ def test_accel_pipelined_matches_oracle(graph):
     for ev in ordered:
         hp.insert_event_and_run_consensus(Event(ev.body, ev.signature),
                                           set_wire_info=True)
-    # Drain: each flush applies one in-flight sweep and may launch another;
-    # stop when nothing is in flight and the state has stopped changing.
-    prev = None
-    for _ in range(200):
-        inf = hp.accel._inflight
-        if inf is not None:
-            inf.done.wait(10.0)
-        hp._accel_pending = max(hp._accel_pending, 1)
-        hp.flush_consensus()
-        if hp.accel.busy():
-            continue
-        cur = _consensus_state(hp)
-        if cur == prev:
-            break
-        prev = cur
+    drain_pipelined(hp)
     assert hp.accel.sweeps > 0
     assert hp.accel.fallbacks == 0
     assert _consensus_state(hp) == _consensus_state(oracle)
