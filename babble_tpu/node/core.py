@@ -177,8 +177,19 @@ class Core:
                         # forced (benchmarking / future hardware).
                         import os
 
+                        from babble_tpu.ops.device import (
+                            is_cpu_fallback,
+                            jax_usable,
+                        )
+
+                        # Opt-in AND a live accelerator: on the CPU/DEAD
+                        # fallbacks the ladder kernel would run on host
+                        # XLA (or hang importing jax), losing badly to
+                        # the native verifier below.
                         use_device_verify = (
                             os.environ.get("BABBLE_DEVICE_VERIFY") == "1"
+                            and jax_usable()
+                            and not is_cpu_fallback()
                         )
                     if use_device_verify:
                         from babble_tpu.ops.verify import prevalidate_events

@@ -130,7 +130,11 @@ class Node(StateManager):
                     self._prewarm_thread.join(timeout=300.0)
             from babble_tpu.ops.device import jax_usable
 
-            if os.environ.get("BABBLE_DEVICE_VERIFY") == "1" and jax_usable():
+            if (
+                os.environ.get("BABBLE_DEVICE_VERIFY") == "1"
+                and jax_usable()
+                and not is_cpu_fallback()
+            ):
                 # Device signature verification is opt-in (measured ~90x
                 # slower than the native verifier through the tunnel); when
                 # forced, compile its kernel before gossip starts.

@@ -122,6 +122,9 @@ def bench_gossip(
     if accelerator:
         # Node startup completes before load: kernel prewarm compiles trace
         # in Python and would otherwise contend with the measured gossip.
+        # Deliberately process-wide and never restored — every accelerated
+        # bench in this run (including subprocess-cluster node children,
+        # which inherit the env) must measure warm-started nodes.
         os.environ["BABBLE_PREWARM_BLOCK"] = "1"
     nodes, proxies, states = [], [], []
     for i, k in enumerate(keys):
@@ -420,18 +423,6 @@ def bench_socket_proxy(window_s: float = 10.0):
         client.close()
 
 
-def bench_16node_tcp(window_s: float = 15.0):
-    """Config 3 (threaded variant): 16 full nodes over localhost TCP in ONE
-    process — kept for comparison; the GIL serializes all 16 nodes, which
-    is why the subprocess variant below is the headline config-3 number."""
-    nodes, proxies, states = _make_tcp_cluster(16, 28100, heartbeat=0.05)
-    try:
-        return _measure(nodes, proxies, states, window_s, warmup_s=8.0)
-    finally:
-        for n in nodes:
-            n.shutdown()
-
-
 def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
                              startup_timeout: float = 120.0,
                              accelerator: bool = False,
@@ -654,7 +645,6 @@ def bench_crossover():
         hd = _replay_inserts(events, peers, acc)
         win = voting.build_voting_window(hd)
         voting.precompile(*voting.bucket_key(win))
-        hd._accel_pending = 1
         t0 = time.perf_counter()
         hd.run_consensus_sweep()
         t_device = time.perf_counter() - t0
@@ -849,7 +839,7 @@ def main_all() -> None:
     except Exception as err:
         out["config3_16node_procs"] = f"unavailable: {err}"
         print(f"config 3 subprocess bench failed: {err}", file=sys.stderr)
-    rate3t = bench_16node_tcp()
+    rate3t, _ = bench_16node_threads(window_s=15.0)
     out["config3_16node_threads_txs_per_s"] = round(rate3t, 1)
     print(f"config 3 (16 threaded nodes): {rate3t:.1f} tx/s", file=sys.stderr)
     rate4, churn = bench_churn()

@@ -1,0 +1,357 @@
+"""Tier-2 core tests: several Core objects wired by directly calling each
+other's sync methods — consensus logic under controlled interleaving, no
+transport at all. Ported from the reference's core suite
+(/root/reference/src/node/core_test.go): initCores/synchronizeCores
+harness (:18, :992), TestEventDiff (:138), TestSync (:174), TestConsensus
+(:379), TestConsensusFF (:463), TestCoreFastForward (:492), and the
+R2Dyn live-join suite TestR2DynConsensus / TestCoreFastForwardAfterJoin
+(:697-981).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import pytest
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.hashgraph import Block, Event, Frame, InmemStore
+from babble_tpu.hashgraph.internal_transaction import InternalTransaction
+from babble_tpu.node.core import Core
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import dummy_commit_response
+
+CACHE_SIZE = 1000
+
+
+def init_cores(n: int):
+    """reference: core_test.go:18-67."""
+    keys = [generate_key() for _ in range(n)]
+    pirs = [
+        Peer(net_addr="", pub_key_hex=k.public_key.hex(), moniker="")
+        for k in keys
+    ]
+    peer_set = PeerSet(pirs)
+    genesis_peer_set = PeerSet(list(pirs))
+    key_of = {k.public_key.id(): k for k in keys}
+
+    cores: List[Core] = []
+    index: Dict[str, str] = {}
+    # cores are aligned with the peer-set's sorted order so "core i" and
+    # "peer i" mean the same thing, like the reference's loop over
+    # peerSet.Peers (core_test.go:36)
+    for i, peer in enumerate(peer_set.peers):
+        key = key_of[peer.id]
+        core = Core(
+            Validator(key, peer.moniker),
+            peer_set,
+            genesis_peer_set,
+            InmemStore(CACHE_SIZE),
+            dummy_commit_response,
+        )
+        initial = Event.new(
+            [], [], [], ["", ""], core.validator.key.public_key.bytes(), 0
+        )
+        core.sign_and_insert_self_event(initial)
+        cores.append(core)
+        index[f"e{i}"] = core.head
+    return cores, key_of, index
+
+
+def synchronize_cores(cores, from_i: int, to_i: int, payload=(),
+                      internal_txs=()):
+    """reference: core_test.go:992-1011."""
+    known_by_to = cores[to_i].known_events()
+    unknown_by_to = cores[from_i].event_diff(known_by_to)
+    unknown_wire = cores[from_i].to_wire(unknown_by_to)
+    cores[to_i].add_transactions(list(payload))
+    for itx in internal_txs:
+        cores[to_i].add_internal_transaction(itx)
+    cores[to_i].sync(cores[from_i].validator.id(), unknown_wire)
+
+
+def sync_and_run_consensus(cores, from_i, to_i, payload=(), internal_txs=()):
+    """reference: core_test.go:1013-1019."""
+    synchronize_cores(cores, from_i, to_i, payload, internal_txs)
+    cores[to_i].process_sig_pool()
+
+
+def name_of(index, h):
+    for name, v in index.items():
+        if v == h:
+            return name
+    return h[:12]
+
+
+def test_event_diff():
+    """reference: core_test.go:138-173."""
+    cores, keys, index = init_cores(3)
+
+    # build P0's view: e01, e20, e12 on top of the three initial events
+    for i in (1, 2):
+        ev = cores[i].get_event(index[f"e{i}"])
+        cores[0].insert_event_and_run_consensus(
+            Event(ev.body, ev.signature), set_wire_info=True
+        )
+    e01 = Event.new([], [], [], [index["e0"], index["e1"]],
+                    cores[0].validator.key.public_key.bytes(), 1)
+    cores[0].sign_and_insert_self_event(e01)
+    index["e01"] = cores[0].head
+
+    key2 = cores[2].validator.key
+    e20 = Event.new([], [], [], [index["e2"], index["e01"]],
+                    key2.public_key.bytes(), 1)
+    e20.sign(key2)
+    cores[0].insert_event_and_run_consensus(e20, set_wire_info=True)
+    index["e20"] = e20.hex()
+
+    key1 = cores[1].validator.key
+    e12 = Event.new([], [], [], [index["e1"], index["e20"]],
+                    key1.public_key.bytes(), 1)
+    e12.sign(key1)
+    cores[0].insert_event_and_run_consensus(e12, set_wire_info=True)
+    index["e12"] = e12.hex()
+
+    known_by_1 = cores[1].known_events()
+    unknown_by_1 = cores[0].event_diff(known_by_1)
+    assert len(unknown_by_1) == 5
+    expected_order = ["e0", "e2", "e01", "e20", "e12"]
+    got = [name_of(index, e.hex()) for e in unknown_by_1]
+    assert got == expected_order
+
+
+def test_sync():
+    """reference: core_test.go:174-296 — three pairwise syncs with exact
+    known-map and head-parent assertions after each."""
+    cores, keys, index = init_cores(3)
+    ids = [c.validator.id() for c in cores]
+
+    # core 1 tells core 0 everything it knows
+    synchronize_cores(cores, 1, 0)
+    known_by_0 = cores[0].known_events()
+    assert known_by_0[ids[0]] == 1
+    assert known_by_0[ids[1]] == 0
+    assert known_by_0[ids[2]] == -1
+    head0 = cores[0].get_head()
+    assert head0.self_parent() == index["e0"]
+    assert head0.other_parent() == index["e1"]
+    index["e01"] = head0.hex()
+
+    # core 0 tells core 2 everything it knows
+    synchronize_cores(cores, 0, 2)
+    known_by_2 = cores[2].known_events()
+    assert known_by_2[ids[0]] == 1
+    assert known_by_2[ids[1]] == 0
+    assert known_by_2[ids[2]] == 1
+    head2 = cores[2].get_head()
+    assert head2.self_parent() == index["e2"]
+    assert head2.other_parent() == index["e01"]
+    index["e20"] = head2.hex()
+
+    # core 2 tells core 1 everything it knows
+    synchronize_cores(cores, 2, 1)
+    known_by_1 = cores[1].known_events()
+    assert known_by_1[ids[0]] == 1
+    assert known_by_1[ids[1]] == 1
+    assert known_by_1[ids[2]] == 1
+    head1 = cores[1].get_head()
+    assert head1.self_parent() == index["e1"]
+    assert head1.other_parent() == index["e20"]
+    index["e12"] = head1.hex()
+
+
+CONSENSUS_PLAYBOOK = [
+    # (from, to, payload)   reference: core_test.go:379-431
+    (0, 1, b"e10"), (1, 2, b"e21"), (2, 0, b"e02"),
+    (0, 1, b"f1"), (1, 0, b"f0"), (1, 2, b"f2"),
+    (0, 1, b"f10"), (1, 2, b"f21"), (2, 0, b"f02"),
+    (0, 1, b"g1"), (1, 0, b"g0"), (1, 2, b"g2"),
+    (0, 1, b"g10"), (1, 2, b"g21"), (2, 0, b"g02"),
+    (0, 1, b"h1"), (1, 0, b"h0"), (1, 2, b"h2"),
+]
+
+
+def test_consensus():
+    """reference: core_test.go:433-461 — 18 syncs drive round 0 to
+    consensus; all three cores agree on the same 6 consensus events."""
+    cores, _, _ = init_cores(3)
+    for from_i, to_i, payload in CONSENSUS_PLAYBOOK:
+        sync_and_run_consensus(cores, from_i, to_i, [payload])
+
+    c0 = cores[0].hg.store.consensus_events()
+    assert len(c0) == 6
+    assert cores[1].hg.store.consensus_events() == c0
+    assert cores[2].hg.store.consensus_events() == c0
+
+
+FF_PLAYBOOK = [
+    # reference: core_test.go:437-456 (4 cores)
+    (1, 2, b"e21"), (2, 3, b"e32"), (3, 1, b"e13"),
+    (1, 2, b"w12"), (2, 3, b"w13"), (3, 1, b"w11"),
+    (1, 2, b"f21"), (2, 3, b"w23"), (3, 2, b"w22"), (2, 1, b"w21"),
+    (1, 2, b"g21"), (2, 3, b"w33"), (3, 2, b"w32"), (2, 1, b"w31"),
+]
+
+
+def init_ff_cores():
+    cores, _, _ = init_cores(4)
+    for from_i, to_i, payload in FF_PLAYBOOK:
+        sync_and_run_consensus(cores, from_i, to_i, [payload])
+    return cores
+
+
+def test_consensus_ff():
+    """reference: core_test.go:463-490."""
+    cores = init_ff_cores()
+    assert cores[1].get_last_consensus_round_index() == 1
+    c1 = cores[1].hg.store.consensus_events()
+    assert len(c1) == 6
+    assert cores[2].hg.store.consensus_events() == c1
+    assert cores[3].hg.store.consensus_events() == c1
+
+
+def test_core_fast_forward():
+    """reference: core_test.go:492-656 — anchor-block selection and the
+    signature threshold gate on fastForward, then a positive reset."""
+    cores = init_ff_cores()
+
+    # no anchor block yet
+    with pytest.raises(Exception):
+        cores[1].get_anchor_block_with_frame()
+
+    block0 = cores[1].hg.store.get_block(0)
+
+    # collect signatures of block 0 from cores 1..3
+    signatures = []
+    for c in cores[1:]:
+        b = c.hg.store.get_block(0)
+        signatures.append(c.sign_block(b))
+
+    # only one signature: not enough for the >1/3 threshold at 4 peers
+    block0.set_signature(signatures[0])
+    cores[1].hg.store.set_block(block0)
+    cores[1].hg.anchor_block = 0
+    block, frame = cores[1].get_anchor_block_with_frame()
+    with pytest.raises(Exception):
+        cores[0].fast_forward(block, frame)
+
+    # append the 2nd and 3rd signatures
+    for sig in signatures[1:]:
+        block0.set_signature(sig)
+    cores[1].hg.store.set_block(block0)
+    block, frame = cores[1].get_anchor_block_with_frame()
+
+    # wire round-trip clears computed fields, like the reference's
+    # marshal/unmarshal (core_test.go:570-573)
+    frame = Frame.from_dict(frame.to_dict())
+    block = Block.from_dict(block.to_dict())
+
+    cores[0].fast_forward(block, frame)
+
+    known_by_0 = cores[0].known_events()
+    ids = [c.validator.id() for c in cores]
+    assert known_by_0 == {ids[0]: -1, ids[1]: 1, ids[2]: 1, ids[3]: 1}
+    assert cores[0].get_last_consensus_round_index() == 1
+    assert cores[0].hg.store.last_block_index() == 0
+    s_block = cores[0].hg.store.get_block(block.index())
+    assert s_block.body.hash() == block.body.hash()
+
+
+R2DYN_CORE_PLAYBOOK = [
+    # reference: core_test.go:710-749; the itx rides play 4 (w12)
+    (0, 1, b"e10", False), (1, 2, b"e21", False), (2, 0, b"e12", False),
+    (0, 1, b"w11", False), (1, 2, b"w12", True), (2, 0, b"w10", False),
+    (0, 1, b"f10", False), (1, 2, b"w22", False), (2, 0, b"w20", False),
+    (0, 1, b"w21", False), (1, 2, b"g21", False), (2, 0, b"w30", False),
+    (0, 1, b"w31", False), (1, 2, b"w32", False), (2, 1, b"h12", False),
+    (1, 0, b"w40", False), (0, 1, b"w41", False), (1, 2, b"w42", False),
+    (2, 1, b"i12", False), (1, 0, b"w50", False), (0, 1, b"w51", False),
+    (1, 2, b"w52", False), (2, 1, b"j12", False), (1, 0, b"w60", False),
+    (0, 1, b"w61", False), (1, 2, b"w62", False), (2, 1, b"k12", False),
+    (1, 0, b"w70", False), (0, 1, b"w71", False), (1, 2, b"w72", False),
+    (2, 1, b"l12", False), (1, 0, b"w80", False), (0, 1, b"w81", False),
+    (1, 2, b"w82", False),
+]
+
+
+def init_r2dyn_cores():
+    """A JoinRequest submitted at round 1, received at round 2, updating
+    the peer-set at round 8 (2+6) — reference: core_test.go:697-756."""
+    cores, _, _ = init_cores(3)
+    bob_key = generate_key()
+    bob_peer = Peer(net_addr="", pub_key_hex=bob_key.public_key.hex(),
+                    moniker="")
+    itx = InternalTransaction.join(bob_peer)
+    itx.sign(bob_key)
+
+    for from_i, to_i, payload, with_itx in R2DYN_CORE_PLAYBOOK:
+        sync_and_run_consensus(
+            cores, from_i, to_i, [payload], [itx] if with_itx else []
+        )
+    return cores, bob_peer, bob_key
+
+
+def test_r2dyn_consensus():
+    """reference: core_test.go:758-786."""
+    cores, _, _ = init_r2dyn_cores()
+    for i, c in enumerate(cores):
+        block1 = c.hg.store.get_block(1)
+        assert len(block1.internal_transactions()) == 1, f"core {i}"
+        receipts = block1.body.internal_transaction_receipts
+        assert len(receipts) == 1, f"core {i}"
+        assert receipts[0].accepted, f"core {i}"
+        assert c.get_last_consensus_round_index() == 6, f"core {i}"
+        ps8 = c.hg.store.get_peer_set(8)
+        assert len(ps8.peers) == 4, f"core {i}"
+
+
+def test_core_fast_forward_after_join():
+    """reference: core_test.go:788-981 — bob fast-forwards from block 0
+    (below the peer-set change) and from the anchor block; both land him
+    in sync with the cluster."""
+    cores, bob_peer, bob_key = init_r2dyn_cores()
+    init_peer_set = cores[0].hg.store.get_peer_set(0)
+    genesis = PeerSet(list(init_peer_set.peers))
+
+    ids = [c.validator.id() for c in cores]
+
+    plays = []
+    block0 = cores[2].hg.store.get_block(0)
+    frame0 = cores[2].hg.store.get_frame(block0.round_received())
+    plays.append((block0, frame0))
+    anchor_block, anchor_frame = cores[2].get_anchor_block_with_frame()
+    plays.append((anchor_block, anchor_frame))
+
+    for block, frame in plays:
+        bob = Core(
+            Validator(bob_key, bob_peer.moniker),
+            init_peer_set,
+            genesis,
+            InmemStore(CACHE_SIZE),
+            dummy_commit_response,
+        )
+        bob.set_head_and_seq()
+        test_cores = cores + [bob]
+
+        # wire round-trip clears computed fields (core_test.go:860-880)
+        block_w = Block.from_dict(block.to_dict())
+        frame_w = Frame.from_dict(frame.to_dict())
+        bob.fast_forward(block_w, frame_w)
+        sync_and_run_consensus(test_cores, 2, 3)
+
+        known_by_bob = bob.known_events()
+        expected = {ids[0]: 9, ids[1]: 15, ids[2]: 10,
+                    bob.validator.id(): 0}
+        assert known_by_bob == expected
+
+        # peer-sets match the donor from the frame's round upward
+        for r in range(block.round_received(), 9):
+            assert (
+                bob.hg.store.get_peer_set(r).hash()
+                == cores[2].hg.store.get_peer_set(r).hash()
+            ), f"peer-set {r}"
+
+        assert bob.get_last_consensus_round_index() == 6
+        assert bob.hg.store.last_block_index() == 5

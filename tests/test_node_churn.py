@@ -1,0 +1,243 @@
+"""Churn-storm tests: nodes joining, leaving, and being killed/restarted
+under continuous load — ported from the reference's extra suite
+(/root/reference/src/node/node_extra_test.go:30-332: TestSuccessiveJoin
+RequestExtra, TestSuccessiveLeaveRequestExtra, TestSimultaneousLeave
+RequestExtra, TestJoinLeaveRequestExtra), plus an accelerated-path storm:
+the accelerator's machinery (background compiles, in-flight sweeps,
+fallbacks) must survive membership churn, which resets and rebases the
+hashgraphs under it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from babble_tpu.hashgraph.accel import TensorConsensus
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.state import State
+from babble_tpu.peers.peer_set import PeerSet
+
+from test_node import (
+    bombard_and_wait,
+    check_gossip,
+    make_cluster,
+    shutdown_all,
+)
+from test_node_dyn import Bombardier, make_extra_node, wait_until
+
+
+def check_peer_sets(nodes, timeout: float = 30.0):
+    """All live nodes converge on the same validator set — waiting out the
+    effective-round (+6) application lag between a membership commit and
+    each node's peers update (reference: node_dyn_test.go checkPeerSets)."""
+    wait_until(
+        lambda: len({n.core.peers.hash() for n in nodes}) == 1,
+        timeout,
+        "peer sets never converged: "
+        + ", ".join(
+            f"{n.get_id()}={len(n.core.peers.peers)}" for n in nodes
+        ),
+    )
+
+
+def test_successive_joins():
+    """Three nodes join a 1-node cluster one after another; after each
+    join every node holds the same chain and peer-set
+    (reference: node_extra_test.go:78-145)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(1, network)
+    genesis = nodes[0].core.genesis_peers
+    extra = []
+    bomb = Bombardier(proxies).start()
+    try:
+        nodes[0].run_async()
+        target = 3
+        for i in range(1, 4):
+            joiner, jp = make_extra_node(
+                network, PeerSet(list(nodes[0].core.peers.peers)),
+                genesis, f"monika{i}",
+            )
+            extra.append(joiner)
+            joiner.run_async()
+            wait_until(
+                lambda: joiner.get_state() == State.BABBLING,
+                60.0,
+                f"joiner {i} never reached BABBLING",
+            )
+            live = nodes + extra
+            bombard_and_wait(
+                live, proxies, target_block=target, timeout=60.0
+            )
+            # every node agrees on the latest blocks all of them hold
+            lo = min(n.get_last_block_index() for n in live)
+            check_gossip(live, max(0, lo - 1), lo)
+            check_peer_sets(live)
+            target += 3
+    finally:
+        bomb.stop()
+        shutdown_all(nodes + extra)
+
+
+def test_successive_leaves():
+    """4-node cluster; nodes leave one at a time down to a single node,
+    which keeps committing alone (reference: node_extra_test.go:146-198)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    bomb = Bombardier(proxies).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        live = list(nodes)
+        live_proxies = list(proxies)
+        target = 2
+        while len(live) > 1:
+            bombard_and_wait(live, live_proxies, target, timeout=60.0)
+            check_gossip(live, 0, 1)
+
+            leaving = live.pop()
+            live_proxies.pop()
+            leaving.leave()
+            assert leaving.get_state() == State.SHUTDOWN
+
+            target += 2
+            bombard_and_wait(live, live_proxies, target, timeout=60.0)
+            check_gossip(live, 0, 1)
+            check_peer_sets(live)
+            lid = leaving.get_id()
+            wait_until(
+                lambda: all(lid not in n.core.validators.by_id for n in live),
+                30.0,
+                "leaver still in validator sets",
+            )
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+
+
+def test_simultaneous_leaves():
+    """Two of four nodes leave at (nearly) the same time; the remaining
+    two keep committing (reference: node_extra_test.go:200-241)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    bomb = Bombardier(proxies).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, 2, timeout=60.0)
+        check_gossip(nodes, 0, 1)
+
+        nodes[3].leave()
+        nodes[2].leave()
+
+        live = nodes[:2]
+        target = nodes[0].get_last_block_index() + 3
+        bombard_and_wait(live, proxies[:2], target, timeout=60.0)
+        check_gossip(live, 0, 1)
+        check_peer_sets(live)
+        assert len(live[0].core.validators.peers) == 2
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+
+
+def test_join_leave_under_load():
+    """One node leaves while a new one joins, all under continuous load
+    (reference: node_extra_test.go:243-330)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    genesis = nodes[0].core.genesis_peers
+    joiner = None
+    bomb = Bombardier(proxies).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, 2, timeout=60.0)
+
+        nodes[3].leave()
+        live = nodes[:3]
+
+        joiner, jp = make_extra_node(
+            network, PeerSet(list(live[0].core.peers.peers)),
+            genesis, "new-node",
+        )
+        joiner.run_async()
+        wait_until(
+            lambda: joiner.get_state() == State.BABBLING,
+            60.0,
+            "joiner never reached BABBLING",
+        )
+        live.append(joiner)
+        target = live[0].get_last_block_index() + 3
+        bombard_and_wait(live, proxies[:3], target, timeout=60.0)
+        check_gossip(live, 0, 1)
+        check_peer_sets(live)
+        jid = joiner.get_id()
+        lid = nodes[3].get_id()
+        assert jid in live[0].core.validators.by_id
+        assert lid not in live[0].core.validators.by_id
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if joiner is not None:
+            joiner.shutdown()
+
+
+def test_churn_with_accelerator():
+    """Membership churn with the device consensus pipeline forced on:
+    joins and leaves reset/rebase hashgraphs under in-flight sweeps, and
+    the accelerator must keep consensus identical with zero fallbacks to
+    corrupted state (fallbacks to the oracle are allowed; divergence is
+    not)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network, accelerator=True)
+    genesis = nodes[0].core.genesis_peers
+    for n in nodes:
+        n.core.hg.accel = TensorConsensus(
+            async_compile=False, min_window=0, pipeline=True
+        )
+    joiner = None
+    bomb = Bombardier(proxies).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, 2, timeout=90.0)
+        check_gossip(nodes, 0, 1)
+
+        joiner, jp = make_extra_node(
+            network, PeerSet(list(nodes[0].core.peers.peers)),
+            genesis, "accel-joiner",
+        )
+        joiner.core.hg.accel = TensorConsensus(
+            async_compile=False, min_window=0, pipeline=True
+        )
+        joiner.run_async()
+        wait_until(
+            lambda: joiner.get_state() == State.BABBLING,
+            90.0,
+            "joiner never reached BABBLING",
+        )
+        live = nodes + [joiner]
+        target = nodes[0].get_last_block_index() + 3
+        bombard_and_wait(live, proxies, target, timeout=90.0)
+        check_gossip(live, 0, 1)
+        check_peer_sets(live)
+
+        # one node politely leaves mid-pipeline
+        nodes[2].leave()
+        live = [nodes[0], nodes[1], joiner]
+        target = live[0].get_last_block_index() + 3
+        bombard_and_wait(live, proxies[:2], target, timeout=90.0)
+        check_gossip(live, 0, 1)
+        check_peer_sets(live)
+
+        total_sweeps = sum(
+            int(n.get_stats().get("accel_sweeps") or 0) for n in live
+        )
+        assert total_sweeps > 0, "device pipeline never engaged during churn"
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if joiner is not None:
+            joiner.shutdown()
