@@ -49,6 +49,7 @@ _RUN_FLAGS = {
     "accelerator": ("accelerator", bool),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
+    "signal_ca": ("signal_ca", str),
 }
 
 
@@ -128,9 +129,14 @@ def cmd_signal(args: argparse.Namespace) -> int:
 
     from ..net.signal import SignalServer
 
-    server = SignalServer(args.listen)
+    if bool(args.cert) != bool(args.key):
+        print("--cert and --key must be given together", file=sys.stderr)
+        return 2
+    server = SignalServer(args.listen, cert_file=args.cert,
+                          key_file=args.key)
     addr = server.listen()
-    print(f"signal server listening on {addr}")
+    mode = "TLS" if args.cert else "plaintext"
+    print(f"signal server listening on {addr} ({mode})")
 
     stop = {"flag": False}
 
@@ -250,6 +256,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="signal/relay server host:port (default 127.0.0.1:2443)",
     )
     run.add_argument(
+        "--signal-ca", dest="signal_ca", default=None,
+        help="pinned relay TLS cert (PEM); default datadir/cert.pem if present",
+    )
+    run.add_argument(
         "--proxy-listen", dest="proxy_listen", default="127.0.0.1:1338",
         help="where Babble serves SubmitTx for the app",
     )
@@ -284,6 +294,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sig.add_argument(
         "--listen", default="0.0.0.0:2443", help="bind host:port"
+    )
+    sig.add_argument(
+        "--cert", default=None, help="TLS certificate (PEM); enables TLS"
+    )
+    sig.add_argument(
+        "--key", default=None, help="TLS private key (PEM)"
     )
     sig.set_defaults(fn=cmd_signal)
 

@@ -51,6 +51,10 @@ class Config:
     # can participate without accepting inbound connections.
     signal: bool = False
     signal_addr: str = "127.0.0.1:2443"
+    # Pinned relay TLS certificate (PEM). Defaults to datadir/cert.pem when
+    # present (the reference's cert convention, config/config.go:19-32);
+    # empty = plaintext relay link.
+    signal_ca: str = ""
 
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory

@@ -92,11 +92,17 @@ class Babble:
             from .net.signal import SignalTransport
 
             assert self.key is not None
+            ca = self.config.signal_ca
+            if not ca and self.config.data_dir:
+                candidate = os.path.join(self.config.data_dir, "cert.pem")
+                if os.path.exists(candidate):
+                    ca = candidate
             self.transport = SignalTransport(
                 self.config.signal_addr,
                 self.key,
                 timeout=self.config.tcp_timeout,
                 join_timeout=self.config.join_timeout,
+                ca_file=ca or None,
             )
         else:
             self.transport = TCPTransport(

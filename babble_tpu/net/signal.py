@@ -15,6 +15,23 @@ Wire format: 4-byte big-endian length + JSON (canonical codec, bytes as
 base64). Client -> server first frame registers; after that frames carry
 {"to", "ch", "kind": "req"|"resp", "t": <rpc type byte>, "body", "error"}
 and the server stamps "from" before forwarding.
+
+Security: registration is challenge-response (the server only routes a
+public key to a client that signs the server's nonce with it), and the
+relay link itself can run over TLS — pass ``cert_file``/``key_file`` to
+SignalServer and ``ca_file`` (or ``tls=True`` for system roots) to
+SignalTransport. This matches the reference's WAMP signaling posture
+(WSS + TLS with self-signed certs distributed out of band,
+src/net/signal/wamp/client.go:24-120, wamp/wamp.go:1-19).
+
+Threading note (TLS): each socket has exactly ONE reader thread, and all
+writers serialize on the per-socket lock — i.e. at most one SSL_read and
+one SSL_write run concurrently on an SSL object, the classic
+reader+writer split OpenSSL >= 1.1.0 supports with its per-SSL locking.
+A rare mid-read KeyUpdate colliding with a write can still surface as an
+SSLError; both sides already treat any socket error as a dropped relay
+link (client reconnects with backoff, server unregisters the client), so
+the failure mode is a clean reconnect, not corruption.
 """
 
 from __future__ import annotations
@@ -24,6 +41,7 @@ import logging
 import os
 import queue
 import socket
+import ssl
 import struct
 import threading
 import time
@@ -62,12 +80,20 @@ class SignalServer:
     """Rendezvous/relay router keyed by public key
     (reference: src/net/signal/wamp/server.go:18-98)."""
 
-    def __init__(self, bind_addr: str):
+    def __init__(self, bind_addr: str, cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        """``cert_file``/``key_file``: optional PEM pair; when given, every
+        client connection is wrapped in TLS (reference posture:
+        wamp/server.go serves WSS with a provided cert)."""
         self._bind_addr = bind_addr
         self._listener: Optional[socket.socket] = None
         self._clients: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if cert_file:
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(cert_file, key_file)
 
     def listen(self) -> str:
         host, port_s = self._bind_addr.rsplit(":", 1)
@@ -107,8 +133,22 @@ class SignalServer:
             except OSError:
                 return
             threading.Thread(
-                target=self._serve_client, args=(conn,), daemon=True
+                target=self._handshake_and_serve, args=(conn,), daemon=True
             ).start()
+
+    def _handshake_and_serve(self, conn: socket.socket) -> None:
+        if self._ssl_ctx is not None:
+            try:
+                conn.settimeout(10.0)
+                conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except (ssl.SSLError, OSError, ConnectionError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+        self._serve_client(conn)
 
     def _serve_client(self, conn: socket.socket) -> None:
         pub: Optional[str] = None
@@ -212,11 +252,23 @@ class SignalTransport:
         key,
         timeout: float = 5.0,
         join_timeout: float = 30.0,
+        tls: bool = False,
+        ca_file: Optional[str] = None,
     ):
         """``key`` is the node's PrivateKey: registration must answer the
-        server's challenge with a signature over it."""
+        server's challenge with a signature over it. ``ca_file`` (or
+        ``tls=True`` for system roots) wraps the relay link in TLS —
+        self-signed relay certs are distributed out of band, like the
+        reference's WAMP cert notes (wamp/wamp.go:1-19)."""
         self._server_addr = server_addr
         self._key = key
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if tls or ca_file:
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
+            if ca_file:
+                # self-signed relay cert: trust the pinned cert, match by
+                # key not hostname
+                self._ssl_ctx.check_hostname = False
         self._pub = self._norm(key.public_key.hex())
         self._timeout = timeout
         self._join_timeout = max(join_timeout, timeout)
@@ -243,6 +295,8 @@ class SignalTransport:
     def _connect(self) -> socket.socket:
         host, port_s = self._server_addr.rsplit(":", 1)
         sock = socket.create_connection((host, int(port_s)), timeout=5.0)
+        if self._ssl_ctx is not None:
+            sock = self._ssl_ctx.wrap_socket(sock, server_hostname=host)
         sock.settimeout(10.0)
         challenge = _recv_frame(sock)
         nonce = bytes.fromhex(challenge.get("challenge", ""))
