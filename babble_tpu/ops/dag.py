@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from babble_tpu.ops.intdot import vote_matmul
+
 INT32_MAX = np.int32(2**31 - 1)
 
 
@@ -298,7 +300,8 @@ def decide_fame(
         prev_wit = witness & (rounds == (j - 1))  # [E(w)]
         ss_prev = ss & prev_wit[None, :]  # [E(y), E(w)]
         n_ss = jnp.sum(ss_prev, axis=1)  # [E(y)]
-        yays = (ss_prev.astype(jnp.int32) @ votes.astype(jnp.int32))  # [E(y), E(x)]
+        # the pipeline's FLOPs center, as an exact int8->int32 MXU tally
+        yays = vote_matmul(ss_prev, votes)  # [E(y), E(x)]
         nays = n_ss[:, None] - yays
         v = yays >= nays
         t = jnp.maximum(yays, nays)

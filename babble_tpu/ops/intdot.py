@@ -1,0 +1,20 @@
+"""Shared exact integer contraction for vote tallies.
+
+Vote/strongly-see matrices are 0/1, so int8 operands with an int32
+accumulator compute the same tallies as int32 x int32 (products are 0/1;
+sums are bounded by the contraction length, far below 2^31) while letting
+the TPU tile the contraction onto the MXU's int8 units instead of the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def vote_matmul(a, b) -> jnp.ndarray:
+    """[M, K] x [K, N] 0/1 tally: a @ b with int8 inputs, int32 output."""
+    return jnp.matmul(
+        a.astype(jnp.int8),
+        b.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )

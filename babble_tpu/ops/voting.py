@@ -63,6 +63,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from babble_tpu.common.errors import StoreError
+from babble_tpu.ops.intdot import vote_matmul
 from babble_tpu.common.trilean import Trilean
 
 INT32_MAX = np.int32(2**31 - 1)
@@ -152,9 +153,17 @@ def _fame_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
 
     # SS[s, w, w'] per peer-set slot (oracle: hashgraph.go:172-206 with the
     # per-round peer-set argument). [W, W, P] compare stays small because W
-    # is the witness count, not the event count.
-    ge = (la_w[:, None, :] >= fd_w[None, :, :]).astype(jnp.int32)
-    counts = jnp.einsum("vwp,sp->svw", ge, member.astype(jnp.int32))
+    # is the witness count, not the event count. Operands are 0/1, so int8
+    # inputs with an int32 accumulator are EXACT while letting the TPU tile
+    # the contraction onto the MXU (int8 matmul units) instead of the VPU;
+    # counts are bounded by P (peer axis) which fits int32 trivially.
+    ge = (la_w[:, None, :] >= fd_w[None, :, :]).astype(jnp.int8)
+    counts = jnp.einsum(
+        "vwp,sp->svw",
+        ge,
+        member.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
     ss_all = counts >= sm_s[:, None, None]  # [S, W, W]
 
     def per_round(j, state):
@@ -168,7 +177,7 @@ def _fame_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
         slot_prev = psi[jnp.clip(j - 1, 0, R - 1)]
         ss_prev = ss_all[slot_prev] & prev_w[None, :]  # [W(y), W(w)]
         n_ss = jnp.sum(ss_prev, axis=1, dtype=jnp.int32)
-        yays = ss_prev.astype(jnp.int32) @ votes.astype(jnp.int32)
+        yays = vote_matmul(ss_prev, votes)  # exact int8->int32 MXU tally
         nays = n_ss[:, None] - yays
         v = yays >= nays
         t = jnp.maximum(yays, nays)
