@@ -24,12 +24,17 @@ class LRU:
     def __contains__(self, key: Any) -> bool:
         return key in self._items
 
+    _MISS = object()
+
     def get(self, key: Any) -> tuple[Any, bool]:
         """Return (value, ok); refreshes recency on hit."""
-        if key not in self._items:
+        # single lookup instead of contains+move+getitem: this runs tens
+        # of times per event insert across the six hashgraph caches
+        val = self._items.get(key, LRU._MISS)
+        if val is LRU._MISS:
             return None, False
         self._items.move_to_end(key)
-        return self._items[key], True
+        return val, True
 
     def add(self, key: Any, value: Any) -> bool:
         """Insert/update; returns True if an eviction occurred."""

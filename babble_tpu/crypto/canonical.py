@@ -15,13 +15,29 @@ from typing import Any
 
 
 def _normalize(obj: Any) -> Any:
+    # exact-type fast path ordered by frequency (leaves dominate): this
+    # walk runs for every event hash on the insert hot path. Subclasses
+    # (IntEnum, OrderedDict, namedtuple, ...) miss the fast path and fall
+    # through to the original isinstance chain below, keeping their old
+    # semantics.
+    t = type(obj)
+    if t is str or t is int:
+        return obj
+    if t is bytes or t is bytearray:
+        return base64.b64encode(bytes(obj)).decode("ascii")
+    if t is dict:
+        return {str(k): _normalize(v) for k, v in obj.items()}
+    if t is list or t is tuple:
+        return [_normalize(v) for v in obj]
+    if t is bool or obj is None:
+        return obj
     if isinstance(obj, (bytes, bytearray)):
         return base64.b64encode(bytes(obj)).decode("ascii")
     if isinstance(obj, dict):
         return {str(k): _normalize(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_normalize(v) for v in obj]
-    if isinstance(obj, (str, int, bool)) or obj is None:
+    if isinstance(obj, (str, int, bool)):
         return obj
     raise TypeError(f"non-canonical type {type(obj)!r} in consensus object")
 

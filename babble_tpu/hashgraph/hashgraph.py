@@ -606,48 +606,83 @@ class Hashgraph:
     def decide_round_received(self) -> None:
         """An event is received at the first decided round whose famous
         witnesses ALL see it (reference: hashgraph.go:1002-1095, quoting the
-        whitepaper's 18/03/18 formulation)."""
-        new_undetermined: List[str] = []
+        whitepaper's 18/03/18 formulation).
 
+        Per-round data (info, decidedness, famous witnesses, threshold) is
+        fetched ONCE per pass and shared across the whole undetermined
+        scan — none of it can change mid-stage, and the repeated
+        store/LRU lookups were the pass's hottest lines. Mutated round
+        infos are written back once per round at the end (same final
+        store state; received order within a round is the scan order, as
+        in the reference)."""
+        new_undetermined: List[str] = []
+        # round -> None (missing) | (round_info, decided, famous, sm)
+        rcache: dict = {}
+        dirty: dict = {}
+        last_round = self.store.last_round()
+        lb = self.round_lower_bound
+
+        def round_entry(i: int):
+            e = rcache.get(i, False)
+            if e is False:
+                try:
+                    tr = self.store.get_round(i)
+                except StoreError:
+                    e = None
+                else:
+                    tp = self.store.get_peer_set(i)
+                    decided = tr.witnesses_decided(tp)
+                    fws = tr.famous_witnesses() if decided else ()
+                    e = (tr, decided, fws, tp.super_majority())
+                rcache[i] = e
+            return e
+
+        try:
+            self._rr_scan(new_undetermined, round_entry, dirty, last_round, lb)
+        finally:
+            # flush mutated rounds even if the scan raised mid-pass, so a
+            # persistent store's rounds never trail its already-written
+            # event rows (the old per-event set_round pairing, batched)
+            for i, tr in dirty.items():
+                self.store.set_round(i, tr)
+
+        self.undetermined_events = new_undetermined
+
+    def _rr_scan(self, new_undetermined, round_entry, dirty, last_round,
+                 lb) -> None:
         for x in self.undetermined_events:
             received = False
             r = self.round(x)
 
-            for i in range(r + 1, self.store.last_round() + 1):
-                try:
-                    tr = self.store.get_round(i)
-                except StoreError:
+            for i in range(r + 1, last_round + 1):
+                entry = round_entry(i)
+                if entry is None:
                     # A joiner's first event can have round 0 while others
-                    # have long evicted round 1 (reference: hashgraph.go:1019-1026).
+                    # have long evicted round 1 (reference:
+                    # hashgraph.go:1019-1026).
                     break
+                tr, decided, fws, sm = entry
 
-                t_peers = self.store.get_peer_set(i)
-
-                if not tr.witnesses_decided(t_peers):
-                    # Rounds below the fast-sync lower bound are never decided
-                    # by decide_fame — skip them instead of bailing
-                    # (reference: hashgraph.go:1033-1046).
-                    if self.round_lower_bound is None or self.round_lower_bound < i:
+                if not decided:
+                    # Rounds below the fast-sync lower bound are never
+                    # decided by decide_fame — skip them instead of
+                    # bailing (reference: hashgraph.go:1033-1046).
+                    if lb is None or lb < i:
                         break
                     else:
                         continue
 
-                fws = tr.famous_witnesses()
-                s = [w for w in fws if self.see(w, x)]
-
-                if len(s) == len(fws) and len(s) >= t_peers.super_majority():
+                if len(fws) >= sm and all(self.see(w, x) for w in fws):
                     received = True
                     ex = self.store.get_event(x)
                     ex.set_round_received(i)
                     self.store.set_event(ex)
                     tr.add_received_event(x)
-                    self.store.set_round(i, tr)
+                    dirty[i] = tr
                     break
 
             if not received:
                 new_undetermined.append(x)
-
-        self.undetermined_events = new_undetermined
 
     def process_decided_rounds(self) -> None:
         """Map decided rounds onto Frames and Blocks, committing via the

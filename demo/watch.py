@@ -17,19 +17,25 @@ def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if len(args) > 0 else 4
     base_port = 8000
+    hosts = None  # default: localhost with sequential ports
     for a in sys.argv[1:]:
         if a.startswith("--base-port"):
             base_port = int(a.split("=", 1)[1])
+        elif a.startswith("--hosts"):
+            # compose mode: one service name per node, all on base_port
+            hosts = a.split("=", 1)[1].split(",")
     try:
         while True:
             row = []
             for i in range(n):
                 try:
+                    url = (
+                        f"http://{hosts[i]}:{base_port}/stats"
+                        if hosts
+                        else f"http://127.0.0.1:{base_port + i}/stats"
+                    )
                     d = json.loads(
-                        urllib.request.urlopen(
-                            f"http://127.0.0.1:{base_port + i}/stats",
-                            timeout=2,
-                        ).read()
+                        urllib.request.urlopen(url, timeout=2).read()
                     )
                     row.append(
                         f"n{i}:[{d['state']} blk={d['last_block_index']} "

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Generate testnet configuration for the compose network: one datadir per
+node (priv_key + peers.json + peers.genesis.json) under a shared volume
+(reference counterpart: demo/scripts/build-conf.sh).
+
+Usage: python build_conf.py <n_nodes> <out_dir> [--base-name=node]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from babble_tpu.crypto.keyfile import SimpleKeyfile  # noqa: E402
+from babble_tpu.crypto.keys import generate_key  # noqa: E402
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if len(args) > 0 else 4
+    out = args[1] if len(args) > 1 else "/conf"
+    base = "node"
+    for a in sys.argv[1:]:
+        if a.startswith("--base-name="):
+            base = a.split("=", 1)[1]
+    keys = [generate_key() for _ in range(n)]
+    peers = [
+        {
+            "NetAddr": f"{base}{i}:1337",
+            "PubKeyHex": k.public_key.hex(),
+            "Moniker": f"{base}{i}",
+        }
+        for i, k in enumerate(keys)
+    ]
+    for i, k in enumerate(keys):
+        dd = os.path.join(out, f"{base}{i}")
+        os.makedirs(dd, exist_ok=True)
+        SimpleKeyfile(os.path.join(dd, "priv_key")).write_key(k)
+        for fn in ("peers.json", "peers.genesis.json"):
+            with open(os.path.join(dd, fn), "w") as f:
+                json.dump(peers, f, indent=1)
+    print(f"wrote {n} datadirs under {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

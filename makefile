@@ -1,0 +1,31 @@
+# Build/test entry points (reference counterpart: /root/reference/makefile).
+
+# native: build the C++ batch verifier shared object
+native:
+	python -c "from babble_tpu import native_crypto; assert native_crypto.available(), 'native build failed'"
+
+tests: test
+
+test:
+	python -m pytest tests/ -q
+
+# flagtest: version-flag purity — FLAG must be empty on release branches
+# (reference: make flagtest -> TestFlagEmpty)
+flagtest:
+	BABBLE_FLAGTEST=1 python -m pytest tests/test_version.py -q
+
+# extratests: the long churn-storm suite by itself
+# (reference: make extratests -> -run Extra)
+extratests:
+	python -m pytest tests/test_node_churn.py -q
+
+alltests: test
+
+# multi-chip sharding dry run on a virtual 8-device CPU mesh
+dryrun:
+	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	python bench.py
+
+.PHONY: native tests test flagtest extratests alltests dryrun bench

@@ -1,0 +1,27 @@
+"""Version-flag purity (reference: version_test.go TestFlagEmpty, enforced
+by CI on master — .circleci/config.yml). The purity assert runs only under
+``make flagtest`` (BABBLE_FLAGTEST=1), so feature branches may carry a
+"-dev" flag without failing the default suite — the same split as the
+reference's -run TestFlagEmpty gate."""
+
+import os
+
+import pytest
+
+from babble_tpu import version
+
+
+@pytest.mark.skipif(
+    os.environ.get("BABBLE_FLAGTEST") != "1",
+    reason="release-branch gate; run via `make flagtest`",
+)
+def test_flag_empty():
+    assert version.FLAG == "", (
+        "version.FLAG must be empty on release branches"
+    )
+
+
+def test_version_string():
+    assert version.__version__.startswith(
+        f"{version.MAJOR}.{version.MINOR}.{version.PATCH}"
+    )
