@@ -47,6 +47,7 @@ _RUN_FLAGS = {
     "maintenance_mode": ("maintenance_mode", bool),
     "moniker": ("moniker", str),
     "accelerator": ("accelerator", bool),
+    "accelerator_mesh": ("accelerator_mesh", int),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
@@ -247,6 +248,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--maintenance-mode", dest="maintenance_mode", action="store_true")
     run.add_argument("--moniker", default=None)
     run.add_argument("--accelerator", action="store_true")
+    run.add_argument(
+        "--accelerator-mesh", dest="accelerator_mesh", type=int, default=None,
+        help="shard voting sweeps over this many devices (multi-chip)",
+    )
     run.add_argument(
         "--signal", action="store_true",
         help="relay mode: route gossip via a signal server, addressed by pubkey",

@@ -66,6 +66,10 @@ class Config:
     # TPU acceleration: route batch verification and the DAG consensus
     # sweeps through the JAX kernels in babble_tpu.ops.
     accelerator: bool = False
+    # Multi-chip consensus: shard the voting sweeps over this many devices
+    # (jax.sharding.Mesh; 0 = single device). Only meaningful with
+    # --accelerator; resolved after the device probe in Node.init.
+    accelerator_mesh: int = 0
 
     def __post_init__(self) -> None:
         if not self.database_dir:

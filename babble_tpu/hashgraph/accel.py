@@ -60,7 +60,8 @@ class _Inflight:
 class TensorConsensus:
     def __init__(self, sweep_events: int = 256, async_compile: bool = True,
                  min_window: int | None = None,
-                 pipeline: bool | None = None):
+                 pipeline: bool | None = None,
+                 mesh=None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -83,6 +84,10 @@ class TensorConsensus:
         # holding the core lock. Until a bucket's kernels are ready the
         # oracle carries consensus — output is identical either way.
         self.async_compile = async_compile
+        # Optional jax.sharding.Mesh: sweeps run witness-axis sharded over
+        # the device mesh (parallel/voting_shard.py) instead of on one
+        # device. Output is bit-identical; only placement differs.
+        self.mesh = mesh
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0
@@ -139,36 +144,61 @@ class TensorConsensus:
 
     # -- compile management -------------------------------------------------
 
+    def _use_mesh(self, win) -> bool:
+        """True when _dispatch will take the sharded path for this window
+        (a mesh is configured AND the witness axis divides it)."""
+        return (
+            self.mesh is not None
+            and win.n_witnesses % self.mesh.devices.size == 0
+        )
+
     def _bucket_ready(self, win) -> bool:
-        """True when the window's shape bucket is compiled. Otherwise kicks
-        a background compile (once) and returns False."""
+        """True when the window's shape bucket is compiled FOR THE PATH
+        _dispatch will take (single-device and per-mesh jit caches are
+        separate programs). Otherwise kicks a background compile (once)
+        and returns False."""
         from babble_tpu.ops import voting
 
         if not self.async_compile:
             return True  # compile inline (tests, explicit opt-out)
         key = voting.bucket_key(win)
-        if voting.bucket_ready(key):
+        use_mesh = self._use_mesh(win)
+        if use_mesh:
+            from babble_tpu.parallel import voting_shard
+
+            ready = voting_shard.bucket_ready(self.mesh, key)
+        else:
+            ready = voting.bucket_ready(key)
+        if ready:
             return True
+        gate = (key, use_mesh)
         with self._lock:
-            kick = key not in self._compiling
+            kick = gate not in self._compiling
             if kick:
-                self._compiling.add(key)
+                self._compiling.add(gate)
         if kick:
             threading.Thread(
-                target=self._compile_bucket, args=(key,), daemon=True
+                target=self._compile_bucket, args=(key, use_mesh),
+                daemon=True,
             ).start()
         self.compile_waits += 1
         return False
 
-    def _compile_bucket(self, key: tuple) -> None:
+    def _compile_bucket(self, key: tuple, use_mesh: bool = False) -> None:
         from babble_tpu.ops import voting
 
         try:
             t0 = time.perf_counter()
-            voting.precompile(*key)
+            if use_mesh:
+                from babble_tpu.parallel import voting_shard
+
+                voting_shard.precompile(self.mesh, *key)
+            else:
+                voting.precompile(*key)
             logger.info(
-                "voting kernels ready for bucket %s in %.1fs",
+                "voting kernels ready for bucket %s (mesh=%s) in %.1fs",
                 key,
+                use_mesh,
                 time.perf_counter() - t0,
             )
         except Exception:
@@ -177,7 +207,7 @@ class TensorConsensus:
             logger.warning("bucket %s precompile failed", key, exc_info=True)
         finally:
             with self._lock:
-                self._compiling.discard(key)
+                self._compiling.discard((key, use_mesh))
 
     # -- flush entry point ---------------------------------------------------
 
@@ -242,6 +272,21 @@ class TensorConsensus:
 
     # -- pipelined internals -------------------------------------------------
 
+    def _dispatch(self, win):
+        """Launch the fused sweep — single-device, or witness-axis sharded
+        over the configured mesh (bit-identical output, different
+        placement). Mesh buckets whose W the mesh size doesn't divide fall
+        back to single-device placement."""
+        from babble_tpu.ops import voting
+
+        if self._use_mesh(win):
+            from babble_tpu.parallel import voting_shard
+
+            return voting_shard._jitted(self.mesh)(
+                *voting_shard.place_window(self.mesh, win)
+            )
+        return voting.launch_sweep(win)
+
     def _launch(self, hg) -> bool:
         from babble_tpu.ops import voting
 
@@ -252,7 +297,7 @@ class TensorConsensus:
                 return True  # nothing undecided
             if not self._bucket_ready(win):
                 return False
-            out = voting.launch_sweep(win)
+            out = self._dispatch(win)
         except Exception as err:
             self._note_fallback(err)
             return False
@@ -316,7 +361,7 @@ class TensorConsensus:
                 return False
             t1 = time.perf_counter()
             self.stage_s["build"] += t1 - t0
-            fame, rr = voting.run_sweep(win)
+            fame, rr = voting.read_sweep(self._dispatch(win), win)
             t2 = time.perf_counter()
             self.stage_s["kernel"] += t2 - t1
             voting.apply_fame(hg, win, fame)
@@ -358,6 +403,11 @@ class TensorConsensus:
             "accel_deferred": self.deferred,
             "accel_min_window": self.min_window,
             "accel_pipeline": self.pipeline,
+            "accel_mesh": (
+                "x".join(str(d) for d in self.mesh.devices.shape)
+                if self.mesh is not None
+                else None
+            ),
             "accel_last_sweep_ms": round(1000.0 * self.last_sweep_s, 3),
             "accel_avg_sweep_ms": round(avg_ms, 3),
             "accel_last_window_events": self.last_window_events,
@@ -367,12 +417,13 @@ class TensorConsensus:
         }
 
 
-def prewarm_buckets(n_peers: int, background: bool = True):
+def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
     """Compile (or load from the persistent XLA cache) the window-shape
     buckets a freshly started node is most likely to hit, so the first
     real backlog meets warm kernels instead of a compile wait. Called from
     Node.init when --accelerator is on; runs in a daemon thread by default
-    (compiles happen in XLA's C++ with the GIL released)."""
+    (compiles happen in XLA's C++ with the GIL released). With a mesh,
+    the SHARDED kernels are warmed too (separate jit cache)."""
     from babble_tpu.ops import voting
 
     P = voting._bucket_mult(n_peers, 8)
@@ -390,12 +441,23 @@ def prewarm_buckets(n_peers: int, background: bool = True):
 
     def work() -> None:
         for key in buckets:
-            if voting.bucket_ready(key):
-                continue
-            try:
-                voting.precompile(*key)
-            except Exception:
-                logger.warning("prewarm failed for %s", key, exc_info=True)
+            if not voting.bucket_ready(key):
+                try:
+                    voting.precompile(*key)
+                except Exception:
+                    logger.warning(
+                        "prewarm failed for %s", key, exc_info=True
+                    )
+            if mesh is not None and key[0] % mesh.devices.size == 0:
+                from babble_tpu.parallel import voting_shard
+
+                if not voting_shard.bucket_ready(mesh, key):
+                    try:
+                        voting_shard.precompile(mesh, *key)
+                    except Exception:
+                        logger.warning(
+                            "mesh prewarm failed for %s", key, exc_info=True
+                        )
 
     if background:
         t = threading.Thread(target=work, daemon=True, name="voting-prewarm")

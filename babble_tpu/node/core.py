@@ -49,6 +49,7 @@ class Core:
         proxy_commit_callback: Callable[[Block], object],
         maintenance_mode: bool = False,
         accelerated_verify: bool = False,
+        accelerator_mesh: int = 0,
     ):
         # Gate the TPU batch-verify path behind a flag (the reference's
         # north-star `--accelerator` switch); jax is only imported when on.
@@ -89,9 +90,14 @@ class Core:
         if accelerated_verify:
             # The same flag gates the consensus offload: fame and
             # round-received come off the device in batched sweeps
-            # (reference hot loop: hashgraph.go:644-668).
+            # (reference hot loop: hashgraph.go:644-668). The mesh (for
+            # witness-axis-sharded multi-chip sweeps) is attached later by
+            # Node.init — AFTER the device probe, since building it
+            # initializes the jax backend, which must never happen before
+            # ensure_device() has ruled out a wedged link.
             from ..hashgraph.accel import TensorConsensus
 
+            self.accelerator_mesh = accelerator_mesh
             self.hg.accel = TensorConsensus()
 
     # -- head/seq -----------------------------------------------------------

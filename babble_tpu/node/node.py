@@ -73,6 +73,7 @@ class Node(StateManager):
             proxy.commit_block,
             conf.maintenance_mode,
             accelerated_verify=conf.accelerator,
+            accelerator_mesh=conf.accelerator_mesh,
         )
         self.core_lock = threading.Lock()
         self.trans = trans
@@ -105,10 +106,30 @@ class Node(StateManager):
             # of wedging the node at its first jax call.
             import os
 
-            from babble_tpu.ops.device import ensure_device, is_cpu_fallback
+            from babble_tpu.ops.device import (
+                ensure_device,
+                is_cpu_fallback,
+                jax_usable,
+            )
 
             ensure_device()
 
+            mesh_req = getattr(self.core, "accelerator_mesh", 0)
+            if mesh_req > 1 and jax_usable() and self.core.hg.accel is not None:
+                # Multi-chip sweeps: build the mesh only now, after the
+                # probe has ruled out a wedged device link.
+                from babble_tpu.parallel.mesh import consensus_mesh
+
+                try:
+                    self.core.hg.accel.mesh = consensus_mesh(mesh_req)
+                except Exception:
+                    self.logger.warning(
+                        "--accelerator-mesh %d unavailable (have %s "
+                        "devices?); sweeps run single-device",
+                        mesh_req,
+                        "fewer",
+                        exc_info=True,
+                    )
             if not is_cpu_fallback():
                 # Pre-warm the voting-sweep shape buckets a fresh node is
                 # likely to hit (background thread; XLA compiles with the
@@ -121,14 +142,16 @@ class Node(StateManager):
                 from babble_tpu.hashgraph.accel import prewarm_buckets
 
                 self._prewarm_thread = prewarm_buckets(
-                    len(self.core.peers.peers)
+                    len(self.core.peers.peers),
+                    mesh=self.core.hg.accel.mesh
+                    if self.core.hg.accel is not None
+                    else None,
                 )
                 if (
                     os.environ.get("BABBLE_PREWARM_BLOCK") == "1"
                     and self._prewarm_thread is not None
                 ):
                     self._prewarm_thread.join(timeout=300.0)
-            from babble_tpu.ops.device import jax_usable
 
             if (
                 os.environ.get("BABBLE_DEVICE_VERIFY") == "1"

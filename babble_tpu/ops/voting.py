@@ -504,12 +504,9 @@ def mark_bucket_ready(key: tuple) -> None:
         _ready_buckets.add(key)
 
 
-def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
-    """Compile (or load from the persistent cache) the fused sweep kernel
-    for a shape bucket by running it on an all-invalid dummy window. Called
-    from a background thread (TensorConsensus / node prewarm) so live
-    sweeps never stall on XLA compilation."""
-    win = VotingWindow(
+def dummy_window(W: int, E: int, P: int, S: int, R: int) -> VotingWindow:
+    """An all-invalid window of a given shape bucket, for precompilation."""
+    return VotingWindow(
         creator=np.zeros(E, np.int32),
         index=np.full(E, -1, np.int32),
         rounds=np.full(E, -10, np.int32),
@@ -530,7 +527,14 @@ def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
         lb_gate_r=np.zeros(R, bool),
         base=0,
     )
-    run_sweep(win)
+
+
+def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
+    """Compile (or load from the persistent cache) the fused sweep kernel
+    for a shape bucket by running it on an all-invalid dummy window. Called
+    from a background thread (TensorConsensus / node prewarm) so live
+    sweeps never stall on XLA compilation."""
+    run_sweep(dummy_window(W, E, P, S, R))
     mark_bucket_ready((W, E, P, S, R))
 
 

@@ -247,6 +247,32 @@ def _jitted(mesh: Mesh):
     return fn
 
 
+# per-mesh compiled-bucket registry, mirroring ops.voting's single-device
+# one (the two jit caches are separate programs, so readiness is too)
+_ready_buckets: dict = {}
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (
+        mesh.devices.shape,
+        tuple(d.id for d in mesh.devices.flatten()),
+    )
+
+
+def bucket_ready(mesh: Mesh, key: tuple) -> bool:
+    return key in _ready_buckets.get(_mesh_key(mesh), set())
+
+
+def precompile(mesh: Mesh, W: int, E: int, P: int, S: int, R: int) -> None:
+    """Compile the SHARDED sweep for a shape bucket on this mesh (dummy
+    window through the per-mesh jit), so live flushes never stall on it."""
+    from babble_tpu.ops.voting import dummy_window
+
+    win = dummy_window(W, E, P, S, R)
+    np.asarray(_jitted(mesh)(*place_window(mesh, win)))
+    _ready_buckets.setdefault(_mesh_key(mesh), set()).add((W, E, P, S, R))
+
+
 def run_sharded_sweep(mesh: Mesh, win: VotingWindow):
     """One sharded sweep over a live VotingWindow; returns (fame, rr)
     numpy arrays, identical to ops.voting.run_sweep's."""

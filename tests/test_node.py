@@ -240,3 +240,32 @@ def test_sync_limit_respected():
         assert len(resp.events) == 5
     finally:
         shutdown_all(nodes)
+
+
+def test_gossip_with_mesh_sharded_accelerator():
+    """A live cluster whose device sweeps run witness-axis SHARDED over the
+    8-device mesh (parallel/voting_shard.py) — multi-chip consensus
+    reachable from running nodes, not just the dryrun — still produces
+    byte-identical blocks."""
+    from babble_tpu.hashgraph.accel import TensorConsensus
+    from babble_tpu.parallel.mesh import consensus_mesh
+
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(2, network, accelerator=True)
+    mesh = consensus_mesh(8)
+    for n in nodes:
+        n.core.hg.accel = TensorConsensus(
+            async_compile=False, min_window=0, pipeline=False, mesh=mesh
+        )
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=2)
+        check_gossip(nodes, 0, 2)
+        for n in nodes:
+            stats = n.get_stats()
+            assert int(stats["accel_sweeps"]) > 0, "mesh sweep never ran"
+            assert int(stats["accel_fallbacks"]) == 0
+            assert stats["accel_mesh"] is not None
+    finally:
+        shutdown_all(nodes)
