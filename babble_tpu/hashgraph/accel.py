@@ -441,14 +441,13 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
 
     def work() -> None:
         for key in buckets:
-            if not voting.bucket_ready(key):
-                try:
-                    voting.precompile(*key)
-                except Exception:
-                    logger.warning(
-                        "prewarm failed for %s", key, exc_info=True
-                    )
-            if mesh is not None and key[0] % mesh.devices.size == 0:
+            mesh_covers = (
+                mesh is not None and key[0] % mesh.devices.size == 0
+            )
+            if mesh_covers:
+                # the sharded kernel is the only one _dispatch will ever
+                # run for this bucket — don't burn compile time (and
+                # device contention) on the unused single-device program
                 from babble_tpu.parallel import voting_shard
 
                 if not voting_shard.bucket_ready(mesh, key):
@@ -458,6 +457,13 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
                         logger.warning(
                             "mesh prewarm failed for %s", key, exc_info=True
                         )
+            elif not voting.bucket_ready(key):
+                try:
+                    voting.precompile(*key)
+                except Exception:
+                    logger.warning(
+                        "prewarm failed for %s", key, exc_info=True
+                    )
 
     if background:
         t = threading.Thread(target=work, daemon=True, name="voting-prewarm")

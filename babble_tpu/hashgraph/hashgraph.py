@@ -111,8 +111,16 @@ class Hashgraph:
         self._witness_cache = LRU(cs)
 
     def init(self, peer_set: PeerSet) -> None:
-        """Set the genesis peer-set at round 0 (reference: hashgraph.go:84-89)."""
-        self.store.set_peer_set(0, peer_set)
+        """Set the genesis peer-set at round 0 (reference: hashgraph.go:84-89).
+
+        A store recycled from disk already carries round 0 — the reference
+        drops Init's KeyAlreadyExists on that path (core.go:137 ignores
+        the error), so this does too."""
+        try:
+            self.store.set_peer_set(0, peer_set)
+        except StoreError as err:
+            if not is_store_err(err, StoreErrorKind.KEY_ALREADY_EXISTS):
+                raise
 
     # =========================================================================
     # DAG predicates
@@ -191,6 +199,10 @@ class Hashgraph:
         r = self._round(x)
         self._round_cache.add(x, r)
         return r
+
+    def round_diff(self, x: str, y: str) -> int:
+        """round(x) - round(y) (reference: hashgraph.go:329-341)."""
+        return self.round(x) - self.round(y)
 
     def _round(self, x: str) -> int:
         """Parent round, +1 if x strongly sees a super-majority of

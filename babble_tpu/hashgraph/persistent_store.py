@@ -23,7 +23,7 @@ import threading
 from typing import Dict, List, Optional
 
 from babble_tpu.common.errors import StoreError, StoreErrorKind
-from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.crypto.canonical import canonical_dumps, canonical_loads
 from babble_tpu.hashgraph.block import Block
 from babble_tpu.hashgraph.event import Event, EventBody
 from babble_tpu.hashgraph.frame import Frame, Root
@@ -67,6 +67,13 @@ class PersistentStore:
         # maintenanceMode disables DB writes during bootstrap replay
         # (reference: badger_store.go:848-855)
         self._maintenance = False
+        # NOTE: persisted peer-sets are deliberately NOT preloaded into the
+        # interval cache. The reference's design comment
+        # (badger_store.go:109-118) applies verbatim: membership state must
+        # be reconstructed by replaying events through consensus
+        # (Bootstrap), which re-registers each peer-set at its effective
+        # round — preloading would make that replay collide with
+        # KEY_ALREADY_EXISTS. db_peer_set() exposes the raw rows.
 
     # -- maintenance --------------------------------------------------------
 
@@ -281,6 +288,22 @@ class PersistentStore:
             ).fetchall()
         return [_event_from_json(r[0]) for r in rows]
 
+    def db_peer_set(self, round: int) -> PeerSet:
+        """The persisted peer-set registered at EXACTLY this round (raw DB
+        row, no interval semantics — reference: badger_store.go
+        dbGetPeerSet). Bootstrap replay, not this accessor, rebuilds the
+        live interval cache."""
+        row = self._fetch(
+            "SELECT data FROM peer_sets WHERE round = ?", (round,)
+        )
+        if row is None:
+            raise StoreError(
+                "PeerSetDB", StoreErrorKind.KEY_NOT_FOUND, str(round)
+            )
+        return PeerSet(
+            [Peer.from_dict(d) for d in canonical_loads(row[0].encode())]
+        )
+
     def db_last_block_index(self) -> int:
         row = self._fetch("SELECT MAX(idx) FROM blocks", ())
         return row[0] if row and row[0] is not None else -1
@@ -313,12 +336,20 @@ class PersistentStore:
 
     def _fetch(self, sql: str, args: tuple) -> Optional[tuple]:
         with self._db_lock:
+            if self._db is None:
+                # a gossip thread outliving shutdown's bounded wait must
+                # get a typed miss, not an AttributeError
+                raise StoreError(
+                    "PersistentStore", StoreErrorKind.KEY_NOT_FOUND, "closed"
+                )
             return self._db.execute(sql, args).fetchone()
 
     def _write(self, sql: str, args: tuple) -> None:
         if self._maintenance:
             return
         with self._db_lock:
+            if self._db is None:
+                return  # shutdown race: drop the write like maintenance mode
             self._db.execute(sql, args)
             self._db.commit()
 

@@ -120,16 +120,26 @@ class Node(StateManager):
                 # probe has ruled out a wedged device link.
                 from babble_tpu.parallel.mesh import consensus_mesh
 
-                try:
-                    self.core.hg.accel.mesh = consensus_mesh(mesh_req)
-                except Exception:
+                if mesh_req & (mesh_req - 1):
+                    # W buckets are powers of two, so a non-power-of-two
+                    # mesh could never divide any window — it would be
+                    # reported but never used. Refuse it loudly instead.
                     self.logger.warning(
-                        "--accelerator-mesh %d unavailable (have %s "
-                        "devices?); sweeps run single-device",
+                        "--accelerator-mesh %d is not a power of two; no "
+                        "witness bucket would ever shard over it — "
+                        "running single-device",
                         mesh_req,
-                        "fewer",
-                        exc_info=True,
                     )
+                else:
+                    try:
+                        self.core.hg.accel.mesh = consensus_mesh(mesh_req)
+                    except Exception:
+                        self.logger.warning(
+                            "--accelerator-mesh %d unavailable (fewer "
+                            "devices?); sweeps run single-device",
+                            mesh_req,
+                            exc_info=True,
+                        )
             if not is_cpu_fallback():
                 # Pre-warm the voting-sweep shape buckets a fresh node is
                 # likely to hit (background thread; XLA compiles with the

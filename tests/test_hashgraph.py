@@ -1040,3 +1040,12 @@ def test_sparse_hashgraph_reset():
     h.decide_round_received()
     h.process_decided_rounds()
     _reset_and_continue(h, index, peer_set, 5)
+
+
+def test_round_diff(round_graph):
+    """reference: hashgraph_test.go:701-724 TestRoundDiff."""
+    h, index, nodes, peer_set = round_graph
+    h.divide_rounds()
+    assert h.round_diff(index["f1"], index["e02"]) == 1
+    assert h.round_diff(index["e02"], index["f1"]) == -1
+    assert h.round_diff(index["e02"], index["e21"]) == 0

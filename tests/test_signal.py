@@ -62,8 +62,8 @@ def _responder(trans, stop: threading.Event):
 
 def test_rpc_roundtrip_via_relay(server):
     ka, kb = generate_key(), generate_key()
-    ta = SignalTransport(server.addr(), ka)
-    tb = SignalTransport(server.addr(), kb)
+    ta = SignalTransport(server.addr(), ka, timeout=20.0)
+    tb = SignalTransport(server.addr(), kb, timeout=20.0)
     ta.listen()
     tb.listen()
     stop = threading.Event()
@@ -154,7 +154,7 @@ def test_unauthenticated_registration_rejected(server):
     assert raw.recv(1) == b"", "impostor connection not closed"
     # ...and the victim must still be routable
     other = generate_key()
-    to = SignalTransport(server.addr(), other)
+    to = SignalTransport(server.addr(), other, timeout=20.0)
     to.listen()
     resp = to.sync(victim.public_key.hex(), SyncRequest(1, {}, 10))
     assert resp.from_id == 42
@@ -248,7 +248,7 @@ def test_reconnecting_client_replaces_registration(server):
     """A client re-registering under the same pubkey takes over routing
     (the reference renegotiates the peer connection the same way)."""
     ka, kb = generate_key(), generate_key()
-    ta = SignalTransport(server.addr(), ka)
+    ta = SignalTransport(server.addr(), ka, timeout=20.0)
     ta.listen()
     tb1 = SignalTransport(server.addr(), kb)
     tb1.listen()
