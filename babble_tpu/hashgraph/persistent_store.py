@@ -174,6 +174,8 @@ class PersistentStore:
         key = event.hex()
         d = {"Body": event.body.to_dict(), "Signature": event.signature}
         with self._db_lock:
+            if self._db is None:
+                return  # shutdown race: drop the write like maintenance mode
             cur = self._db.execute("SELECT topo FROM events WHERE key = ?", (key,))
             row = cur.fetchone()
             topo = row[0] if row else self._next_topo
@@ -197,6 +199,8 @@ class PersistentStore:
             if err.kind != StoreErrorKind.TOO_LATE:
                 raise
             with self._db_lock:
+                if self._db is None:
+                    raise err  # shutdown race: surface the original miss
                 rows = self._db.execute(
                     "SELECT hash FROM participant_events "
                     "WHERE participant = ? AND idx > ? ORDER BY idx",
@@ -282,6 +286,8 @@ class PersistentStore:
         """Events in insert order, for bootstrap replay
         (reference: badger_store.go dbTopologicalEvents / hashgraph.go:1481)."""
         with self._db_lock:
+            if self._db is None:
+                return []  # shutdown race: nothing left to replay
             rows = self._db.execute(
                 "SELECT data FROM events ORDER BY topo LIMIT ? OFFSET ?",
                 (count, skip),

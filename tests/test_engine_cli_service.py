@@ -105,6 +105,18 @@ def test_engine_testnet_with_service(tmp_path):
         history = json.loads(urllib.request.urlopen(f"{base}/history").read())
         assert "0" in history
 
+        validators = json.loads(
+            urllib.request.urlopen(f"{base}/validators/0").read()
+        )
+        assert len(validators) == 2
+
+        timers = json.loads(
+            urllib.request.urlopen(f"{base}/debug/timers").read()
+        )
+        assert isinstance(timers, dict)
+        stacks = urllib.request.urlopen(f"{base}/debug/stacks").read()
+        assert b"Thread" in stacks or b"thread" in stacks
+
         # unknown route -> 404
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"{base}/nope")

@@ -241,3 +241,65 @@ def test_churn_with_accelerator():
         shutdown_all(nodes)
         if joiner is not None:
             joiner.shutdown()
+
+
+def test_byzantine_forker_rejected_under_gossip():
+    """A Byzantine actor replays a VALIDATOR's key to fork an existing
+    slot: a second, validly-signed event at the same (creator, index) with
+    the same self-parent but different payload, pushed to every node via
+    EagerSync. Fork prevention at insert (check_self_parent,
+    hashgraph.go:405-429) must keep the forged branch out of every honest
+    DAG while the cluster keeps committing identical blocks. (A
+    non-validator's events never even reach fork detection — they fail
+    participant lookup — so the fork MUST come from a validator key.)"""
+    from babble_tpu.hashgraph.event import Event
+    from babble_tpu.net.rpc import EagerSyncRequest
+
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    rogue_t = network.new_transport("inmem://rogue")
+    bomb = Bombardier(proxies).start()
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, 1, timeout=60.0)
+
+        # steal node3's key (the Byzantine validator), fork one of its
+        # already-gossiped slots
+        victim = nodes[3]
+        vkey = victim.core.validator.key
+        genuine = victim.core.get_event(victim.core.head)
+        fork = Event.new(
+            [b"forked-branch"], [], [],
+            [genuine.self_parent(), genuine.other_parent()],
+            vkey.public_key.bytes(), genuine.index(),
+            timestamp=genuine.body.timestamp,
+        )
+        fork.sign(vkey)
+        assert fork.hex() != genuine.hex()
+
+        for i in range(3):  # push at the honest nodes
+            try:
+                rogue_t.eager_sync(
+                    f"inmem://node{i}", EagerSyncRequest(victim.get_id(), [fork.to_wire()])
+                )
+            except Exception:
+                pass  # refusal may surface as an RPC error
+
+        # the forged branch is in NO honest store
+        for n in nodes:
+            found = True
+            try:
+                n.core.hg.store.get_event(fork.hex())
+            except Exception:
+                found = False
+            assert not found, "forged branch accepted"
+
+        # cluster keeps committing identical blocks
+        target_block = nodes[0].get_last_block_index() + 2
+        bombard_and_wait(nodes, proxies, target_block, timeout=60.0)
+        check_gossip(nodes, 0, 1)
+    finally:
+        bomb.stop()
+        rogue_t.close()
+        shutdown_all(nodes)

@@ -250,18 +250,18 @@ def test_reconnecting_client_replaces_registration(server):
     ka, kb = generate_key(), generate_key()
     ta = SignalTransport(server.addr(), ka, timeout=20.0)
     ta.listen()
-    tb1 = SignalTransport(server.addr(), kb)
+    tb1 = SignalTransport(server.addr(), kb, timeout=20.0)
     tb1.listen()
     stop1 = threading.Event()
     _responder(tb1, stop1)
     resp = ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 10))
     assert resp.from_id == 42
     # second client with the same key replaces the first
-    tb2 = SignalTransport(server.addr(), kb)
+    tb2 = SignalTransport(server.addr(), kb, timeout=20.0)
     tb2.listen()
     stop2 = threading.Event()
     _responder(tb2, stop2)
-    time.sleep(0.2)
+    time.sleep(0.5)  # let the takeover settle under CI load
     resp = ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 10))
     assert resp.from_id == 42
     stop1.set()

@@ -78,6 +78,20 @@ def _make_pair(kind):
         a = net.new_transport("inmem://a")
         b = net.new_transport("inmem://b")
         return a, b, lambda: (a.close(), b.close())
+    if kind == "signal":
+        # relay-routed pair: both sides dial OUT to a rendezvous server
+        # and are addressed by public key (the WebRTC analogue)
+        from babble_tpu.crypto.keys import generate_key
+        from babble_tpu.net.signal import SignalServer, SignalTransport
+
+        relay = SignalServer("127.0.0.1:0")
+        relay.listen()
+        ka, kb = generate_key(), generate_key()
+        a = SignalTransport(relay.addr(), ka, timeout=20.0)
+        b = SignalTransport(relay.addr(), kb, timeout=20.0)
+        a.listen()
+        b.listen()
+        return a, b, lambda: (a.close(), b.close(), relay.close())
     srv = TCPTransport("127.0.0.1:0")
     srv.listen()
     cli = TCPTransport("127.0.0.1:0")
@@ -85,7 +99,7 @@ def _make_pair(kind):
     return cli, srv, lambda: (cli.close(), srv.close())
 
 
-@pytest.fixture(params=["inmem", "tcp"])
+@pytest.fixture(params=["inmem", "tcp", "signal"])
 def pair(request):
     cli, srv, cleanup = _make_pair(request.param)
     stop = threading.Event()
