@@ -154,8 +154,20 @@ def strongly_see_matrix(
     coordinates excluded by the -1 / INT32_MAX sentinels
     (oracle: hashgraph.go:184-206).
 
-    Memory note: materializes [E, E, P]; for big windows call in row blocks.
+    Memory note: materializes [E, E, P]; BABBLE_PALLAS=1 on a real TPU
+    routes this through the Pallas tiled kernel
+    (ops/pallas_kernels.strongly_see_pallas), which streams the peer axis
+    through VMEM instead — O(TILE_X * E) peak, no [E, E, P] intermediate.
     """
+    import os
+
+    if os.environ.get("BABBLE_PALLAS") == "1":
+        from babble_tpu.ops.device import on_tpu
+
+        if on_tpu():
+            from babble_tpu.ops.pallas_kernels import strongly_see_pallas
+
+            return strongly_see_pallas(la, fd, super_majority)
     ge = la[:, None, :] >= fd[None, :, :]  # [E, E, P]
     counts = jnp.sum(ge, axis=-1, dtype=jnp.int32)
     return counts >= super_majority

@@ -66,6 +66,21 @@ def on_accelerator() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def on_tpu() -> bool:
+    """True when the actual default backend is a TPU (incl. the axon
+    tunnel). TPU-layout-specific code (Pallas kernels) gates on this, not
+    on the looser on_accelerator()."""
+    if not jax_usable() or not on_accelerator():
+        return False
+    import jax
+
+    dev = jax.devices()[0]
+    return (
+        dev.platform in ("tpu", "axon")
+        or "TPU" in getattr(dev, "device_kind", "")
+    )
+
+
 def is_cpu_fallback() -> bool:
     """True when the accelerated path is running on host XLA (resolved
     platform is cpu) or the device is dead. Callers use this to route work

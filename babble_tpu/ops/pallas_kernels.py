@@ -1,0 +1,82 @@
+"""Pallas TPU kernels for the consensus hot ops.
+
+``strongly_see_pallas`` computes SS[x, y] = #{p : la[x,p] >= fd[y,p]} >= sm
+(oracle: hashgraph.go:172-206) WITHOUT materializing the [E, E, P] compare
+tensor the jnp formulation builds (ops/dag.py notes it as the big-window
+memory problem: E=4096, P=40 -> 2.7 GB of int8 intermediates for XLA to
+fuse away — or not). The kernel tiles the x axis over a grid; each program
+holds one [P, TILE_X] slice of the (transposed) last-ancestor coordinates
+plus the full [P, E] first-descendant matrix in VMEM and accumulates the
+peer axis with a static loop, so peak memory is O(TILE_X * E).
+
+Layout notes (guide: pallas_guide.md "Tiling Constraints"): operands are
+passed TRANSPOSED ([P, E] instead of [E, P]) so the fast last dimension is
+the big event axis (a multiple of 128 for every bucketed window) and the
+sublane dimension is the peer axis (already padded to a multiple of 8).
+
+Used by ops.dag.strongly_see_matrix when BABBLE_PALLAS=1 on a real TPU;
+always differentially tested in interpreter mode on CPU
+(tests/test_ops_dag.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_X = 128
+INT32_MAX = jnp.int32(2**31 - 1)
+
+
+def _ss_kernel(n_peers: int, super_majority: int, la_t_ref, fd_t_ref,
+               out_ref):
+    """One [TILE_X, E] output tile: count peers p with la[x,p] >= fd[y,p].
+    The peer loop is a static unroll (P <= a few dozen); every iteration
+    is one [TILE_X, E] broadcast compare+add on the VPU."""
+    acc = jnp.zeros(out_ref.shape, jnp.int32)
+    for p in range(n_peers):
+        la_row = la_t_ref[p, :]  # [TILE_X] this block's x coordinates
+        fd_row = fd_t_ref[p, :]  # [E] all candidates' y coordinates
+        acc += (la_row[:, None] >= fd_row[None, :]).astype(jnp.int32)
+    out_ref[:] = acc >= super_majority
+
+
+@partial(jax.jit, static_argnames=("super_majority", "interpret"))
+def strongly_see_pallas(la, fd, super_majority: int, interpret: bool = False):
+    """SS[x, y] over [E, P] coordinate tensors, Pallas-tiled.
+
+    Semantics identical to ops.dag.strongly_see_matrix (missing
+    coordinates excluded by the -1 / INT32_MAX sentinels). Inputs of ANY
+    shape are accepted: the peer axis is padded to a multiple of 8
+    (sublane tiling) with sentinel pairs that can never satisfy the
+    compare (la=-1 vs fd=INT32_MAX), and the event axis to a multiple of
+    TILE_X (lane tiling); the pad rows/columns are sliced off the result.
+    """
+    E, P = la.shape
+    P_pad = -P % 8
+    E_pad = -E % TILE_X
+    if P_pad:
+        la = jnp.pad(la, ((0, 0), (0, P_pad)), constant_values=-1)
+        fd = jnp.pad(fd, ((0, 0), (0, P_pad)), constant_values=INT32_MAX)
+    if E_pad:
+        la = jnp.pad(la, ((0, E_pad), (0, 0)), constant_values=-1)
+        fd = jnp.pad(fd, ((0, E_pad), (0, 0)), constant_values=INT32_MAX)
+    Ep, Pp = la.shape
+    la_t = la.T  # [Pp, Ep]
+    fd_t = fd.T
+    kernel = partial(_ss_kernel, Pp, super_majority)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Ep // TILE_X,),
+        in_specs=[
+            pl.BlockSpec((Pp, TILE_X), lambda i: (0, i)),  # block's x rows
+            pl.BlockSpec((Pp, Ep), lambda i: (0, 0)),  # all candidates
+        ],
+        out_specs=pl.BlockSpec((TILE_X, Ep), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Ep, Ep), jnp.bool_),
+        interpret=interpret,
+    )(la_t, fd_t)
+    return out[:E, :E]

@@ -122,3 +122,43 @@ def test_prevalidate_events_host():
     assert prevalidate_events_host(events) is True
     for i, ev in enumerate(events):
         assert ev.verify() is (i != 3)
+
+
+def test_cross_backend_sign_verify_agreement():
+    """All three host backends — native C++, OpenSSL, pure Python — must
+    agree on validity for the same vectors: every backend's signature
+    verifies under every other backend, and corrupted signatures fail
+    everywhere (the kind of divergence that would fork consensus)."""
+    import hashlib
+
+    from babble_tpu import native_crypto
+    from babble_tpu.crypto import keys as K
+    from babble_tpu.crypto import secp256k1 as ref
+
+    if not native_crypto.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+
+    key = K.generate_key()
+    pub = key.public_key
+    pub_bytes = pub.x.to_bytes(32, "big") + pub.y.to_bytes(32, "big")
+
+    for i in range(4):
+        h = hashlib.sha256(f"vector {i}".encode()).digest()
+        # sign via the default (OpenSSL-preferred) path and the pure
+        # oracle; both must verify under every backend
+        sigs = [key.sign_rs(h), ref.sign(key.d, h)]
+        for r, s in sigs:
+            assert native_crypto.verify_one(pub_bytes, h, r, s) is True
+            assert ref.verify((pub.x, pub.y), h, r, s)
+            assert pub.verify_rs(h, r, s)
+            # corrupted: flip the hash
+            h2 = hashlib.sha256(h).digest()
+            assert native_crypto.verify_one(pub_bytes, h2, r, s) is False
+            assert not ref.verify((pub.x, pub.y), h2, r, s)
+            assert not pub.verify_rs(h2, r, s)
+            # corrupted: tweak s
+            s2 = s + 1 if s + 1 < ref.N else s - 1
+            assert native_crypto.verify_one(pub_bytes, h, r, s2) is False
+            assert not ref.verify((pub.x, pub.y), h, r, s2)

@@ -264,11 +264,24 @@ def test_byzantine_forker_rejected_under_gossip():
             n.run_async()
         bombard_and_wait(nodes, proxies, 1, timeout=60.0)
 
-        # steal node3's key (the Byzantine validator), fork one of its
-        # already-gossiped slots
+        # steal node3's key (the Byzantine validator) and fork a slot that
+        # EVERY honest node already holds — check_self_parent only detects
+        # a fork when the genuine sibling is present, so wait for the
+        # gossip to spread it first
         victim = nodes[3]
         vkey = victim.core.validator.key
         genuine = victim.core.get_event(victim.core.head)
+
+        def all_have_genuine():
+            for n in nodes:
+                try:
+                    n.core.hg.store.get_event(genuine.hex())
+                except Exception:
+                    return False
+            return True
+
+        wait_until(all_have_genuine, 30.0, "genuine event never spread")
+
         fork = Event.new(
             [b"forked-branch"], [], [],
             [genuine.self_parent(), genuine.other_parent()],

@@ -97,3 +97,32 @@ def test_jit_compiles_once():
     out2 = dag_ops.run_pipeline(snapshot)
     assert dag_ops._trace_count == traces_after_first, "pipeline retraced"
     np.testing.assert_array_equal(out1["rounds"], out2["rounds"])
+
+
+def test_pallas_strongly_see_matches_jnp():
+    """The Pallas tiled strongly-see kernel (interpreter mode on CPU) is
+    bit-identical to the jnp formulation, including coordinate sentinels
+    and non-128-multiple event counts."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from babble_tpu.ops.dag import INT32_MAX, strongly_see_matrix
+    from babble_tpu.ops.pallas_kernels import strongly_see_pallas
+
+    rng = np.random.RandomState(11)
+    for E, P in ((64, 8), (128, 8), (256, 16), (512, 40)):
+        la = rng.randint(-1, 40, size=(E, P)).astype(np.int32)
+        fd = rng.randint(0, 40, size=(E, P)).astype(np.int32)
+        fd[rng.rand(E, P) < 0.25] = INT32_MAX
+        la[rng.rand(E, P) < 0.1] = -1
+        sm = 2 * P // 3 + 1
+        want = np.asarray(
+            strongly_see_matrix(jnp.asarray(la), jnp.asarray(fd), sm)
+        )
+        got = np.asarray(
+            strongly_see_pallas(
+                jnp.asarray(la), jnp.asarray(fd), sm, interpret=True
+            )
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"E={E} P={P}")
