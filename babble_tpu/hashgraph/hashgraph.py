@@ -543,11 +543,39 @@ class Hashgraph:
 
     def decide_fame(self) -> None:
         """Virtual voting with coin rounds every COIN_ROUND_FREQ rounds
-        (reference: hashgraph.go:875-998)."""
+        (reference: hashgraph.go:875-998).
+
+        Per-pass memos: round infos / peer-sets / witness lists are
+        fetched once per round, and each voter y's strongly-seen
+        witness list of round j-1 is computed once instead of once per
+        candidate x — none of it changes within the stage (set_fame only
+        mutates the candidate round's info)."""
         votes: Dict[str, Dict[str, bool]] = {}  # votes[y][x] = y's vote on x
 
         def set_vote(y: str, x: str, vote: bool) -> None:
             votes.setdefault(y, {})[x] = vote
+
+        rounds_memo: Dict[int, tuple] = {}  # j -> (info, peer_set, witnesses)
+
+        def round_data(j: int) -> tuple:
+            e = rounds_memo.get(j)
+            if e is None:
+                ri = self.store.get_round(j)
+                ps = self.store.get_peer_set(j)
+                e = (ri, ps, ri.witnesses())
+                rounds_memo[j] = e
+            return e
+
+        ss_memo: Dict[tuple, list] = {}  # (y, j_prev) -> strongly-seen list
+
+        def ss_witnesses_of(y: str, j_prev: int) -> list:
+            k = (y, j_prev)
+            v = ss_memo.get(k)
+            if v is None:
+                _, prev_ps, prev_wits = round_data(j_prev)
+                v = [w for w in prev_wits if self.strongly_see(y, w, prev_ps)]
+                ss_memo[k] = v
+            return v
 
         decided_rounds: List[int] = []
 
@@ -563,24 +591,16 @@ class Hashgraph:
                 for j in range(round_index + 1, self.store.last_round() + 1):
                     if done:
                         break
-                    j_round_info = self.store.get_round(j)
-                    j_peer_set = self.store.get_peer_set(j)
+                    j_round_info, j_peer_set, j_witnesses = round_data(j)
 
-                    for y in j_round_info.witnesses():
+                    for y in j_witnesses:
                         diff = j - round_index
                         if diff == 1:
                             set_vote(y, x, self.see(y, x))
                         else:
-                            j_prev_round_info = self.store.get_round(j - 1)
-                            j_prev_peer_set = self.store.get_peer_set(j - 1)
-
                             # Witnesses of round j-1 strongly seen by y,
                             # based on the round j-1 peer-set.
-                            ss_witnesses = [
-                                w
-                                for w in j_prev_round_info.witnesses()
-                                if self.strongly_see(y, w, j_prev_peer_set)
-                            ]
+                            ss_witnesses = ss_witnesses_of(y, j - 1)
 
                             yays = 0
                             nays = 0

@@ -27,8 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# the missing-coordinate sentinel — single source of truth in ops.dag
+# (cycle-free: dag imports this module only lazily inside
+# strongly_see_matrix)
+from babble_tpu.ops.dag import INT32_MAX
+
 TILE_X = 128
-INT32_MAX = jnp.int32(2**31 - 1)
 
 
 def _ss_kernel(n_peers: int, super_majority: int, la_t_ref, fd_t_ref,

@@ -111,7 +111,9 @@ def test_pallas_strongly_see_matches_jnp():
     from babble_tpu.ops.pallas_kernels import strongly_see_pallas
 
     rng = np.random.RandomState(11)
-    for E, P in ((64, 8), (128, 8), (256, 16), (512, 40)):
+    # includes non-multiple-of-8 peer counts (4, 6) so the sublane
+    # padding branch and its sentinel pairs are exercised too
+    for E, P in ((64, 4), (100, 6), (128, 8), (256, 16), (512, 40)):
         la = rng.randint(-1, 40, size=(E, P)).astype(np.int32)
         fd = rng.randint(0, 40, size=(E, P)).astype(np.int32)
         fd[rng.rand(E, P) < 0.25] = INT32_MAX
