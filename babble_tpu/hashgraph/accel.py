@@ -44,9 +44,9 @@ class _Inflight:
     back while gossip continues."""
 
     __slots__ = ("win", "result", "error", "done", "generation", "t_launch",
-                 "t_done", "topo")
+                 "t_done", "topo", "_slots", "_slot_lock", "_slot_held")
 
-    def __init__(self, win, generation: int, topo: int):
+    def __init__(self, win, generation: int, topo: int, slots=None):
         self.win = win
         self.result = None  # (fame, rr) numpy arrays once read back
         self.error: Optional[BaseException] = None
@@ -55,6 +55,40 @@ class _Inflight:
         self.t_launch = time.perf_counter()
         self.t_done = 0.0  # set by the reader when the readback lands
         self.topo = topo  # hashgraph topological index at snapshot time
+        # Admission-control slot ownership: released exactly once, by the
+        # reader when the readback lands OR by the abandonment path when a
+        # wedged readback times out — whichever gets there first.
+        self._slots = slots
+        self._slot_lock = threading.Lock()
+        self._slot_held = slots is not None
+
+    def release_slot(self) -> None:
+        with self._slot_lock:
+            held, self._slot_held = self._slot_held, False
+        if held:
+            self._slots.release()
+
+
+# Process-wide sweep admission control. Co-located nodes (multi-validator
+# hosts, the 16-node bench, tests) share ONE device and ONE tunnel; without
+# a cap their redundant sweeps convoy on the readback path and per-sweep
+# latency balloons from ~100 ms to 600+ ms. Capping in-flight sweeps keeps
+# device latency flat; flushes that lose the race ride the oracle, which is
+# exactly the small-window economics already encoded in min_window.
+_INFLIGHT_SLOTS: Optional[threading.Semaphore] = None
+_slots_lock = threading.Lock()
+
+
+def _inflight_slots() -> threading.Semaphore:
+    global _INFLIGHT_SLOTS
+    if _INFLIGHT_SLOTS is None:
+        with _slots_lock:
+            if _INFLIGHT_SLOTS is None:
+                import os
+
+                n = int(os.environ.get("BABBLE_ACCEL_MAX_INFLIGHT", "2"))
+                _INFLIGHT_SLOTS = threading.Semaphore(max(1, n))
+    return _INFLIGHT_SLOTS
 
 
 class TensorConsensus:
@@ -93,6 +127,7 @@ class TensorConsensus:
         self.compile_waits = 0
         self.small_windows = 0  # flushes routed to the oracle by min_window
         self.deferred = 0  # flushes that rode behind an in-flight readback
+        self.contended = 0  # launches skipped: device at max in-flight sweeps
         self.generation = 0  # bumped by Hashgraph.reset/bootstrap
         # A sweep whose readback exceeds this is abandoned (tunnel wedge):
         # the oracle takes over so a dead device can stall only one sweep's
@@ -238,9 +273,13 @@ class TensorConsensus:
                     time.perf_counter() - inf.t_launch
                     > self.readback_timeout_s
                 ):
-                    # Tunnel wedge: abandon the sweep (the reader thread
-                    # stays parked on the dead readback, harmless) and let
-                    # the oracle take over so the node keeps deciding.
+                    # Tunnel wedge: abandon the sweep and let the oracle
+                    # take over so the node keeps deciding. Reclaim the
+                    # admission slot here — the parked reader thread may
+                    # never finish, and a leaked slot would silently
+                    # disable the accelerator process-wide (its own
+                    # eventual release is a no-op after this).
+                    inf.release_slot()
                     self._inflight = None
                     self._note_fallback(
                         TimeoutError(
@@ -297,25 +336,44 @@ class TensorConsensus:
                 return True  # nothing undecided
             if not self._bucket_ready(win):
                 return False
-            out = self._dispatch(win)
         except Exception as err:
             self._note_fallback(err)
             return False
         self.stage_s["build"] += time.perf_counter() - t0
-        inf = _Inflight(win, self.generation, hg.topological_index)
+
+        # Admission control covers only actual device occupancy — the
+        # host-side window build above runs slot-free so co-located nodes
+        # aren't starved during work that never touches the device.
+        slots = _inflight_slots()
+        if not slots.acquire(blocking=False):
+            # Device already at max in-flight sweeps (co-located nodes
+            # share it): let the oracle carry this flush instead of
+            # joining a readback convoy.
+            self.contended += 1
+            return False
+        inf = _Inflight(win, self.generation, hg.topological_index, slots)
+        try:
+            out = self._dispatch(win)
+
+            def reader() -> None:
+                try:
+                    inf.result = voting.read_sweep(out, inf.win)
+                except BaseException as e:  # device/tunnel failure
+                    inf.error = e
+                finally:
+                    inf.release_slot()
+                    inf.t_done = time.perf_counter()
+                    inf.done.set()
+
+            threading.Thread(target=reader, daemon=True).start()
+        except BaseException as err:
+            inf.release_slot()
+            if not isinstance(err, Exception):
+                raise  # KeyboardInterrupt & friends propagate
+            self._note_fallback(err)
+            return False
         self._inflight = inf
         self._last_snapshot_topo = hg.topological_index
-
-        def reader() -> None:
-            try:
-                inf.result = voting.read_sweep(out, inf.win)
-            except BaseException as e:  # device/tunnel failure
-                inf.error = e
-            finally:
-                inf.t_done = time.perf_counter()
-                inf.done.set()
-
-        threading.Thread(target=reader, daemon=True).start()
         return True
 
     def _apply(self, hg, inf: _Inflight) -> bool:
@@ -401,6 +459,7 @@ class TensorConsensus:
             "accel_compile_waits": self.compile_waits,
             "accel_small_windows": self.small_windows,
             "accel_deferred": self.deferred,
+            "accel_contended": self.contended,
             "accel_min_window": self.min_window,
             "accel_pipeline": self.pipeline,
             "accel_mesh": (
@@ -437,6 +496,11 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
         (64, 512, P, S, 16),
         (128, 512, P, S, 16),
         (128, 1024, P, S, 16),
+        # sustained backlogs at 16+ validators accumulate rounds past the
+        # R=16 bucket before decisions drain; compiling R=32 up front keeps
+        # mid-run compiles (and their single-core steal) off the bench path
+        (128, 1024, P, S, 32),
+        (256, 1024, P, S, 32),
     ]
 
     def work() -> None:

@@ -555,14 +555,14 @@ class Hashgraph:
         def set_vote(y: str, x: str, vote: bool) -> None:
             votes.setdefault(y, {})[x] = vote
 
-        rounds_memo: Dict[int, tuple] = {}  # j -> (info, peer_set, witnesses)
+        rounds_memo: Dict[int, tuple] = {}  # j -> (peer_set, witnesses)
 
         def round_data(j: int) -> tuple:
             e = rounds_memo.get(j)
             if e is None:
                 ri = self.store.get_round(j)
                 ps = self.store.get_peer_set(j)
-                e = (ri, ps, ri.witnesses())
+                e = (ps, ri.witnesses())
                 rounds_memo[j] = e
             return e
 
@@ -572,7 +572,7 @@ class Hashgraph:
             k = (y, j_prev)
             v = ss_memo.get(k)
             if v is None:
-                _, prev_ps, prev_wits = round_data(j_prev)
+                prev_ps, prev_wits = round_data(j_prev)
                 v = [w for w in prev_wits if self.strongly_see(y, w, prev_ps)]
                 ss_memo[k] = v
             return v
@@ -591,7 +591,7 @@ class Hashgraph:
                 for j in range(round_index + 1, self.store.last_round() + 1):
                     if done:
                         break
-                    j_round_info, j_peer_set, j_witnesses = round_data(j)
+                    j_peer_set, j_witnesses = round_data(j)
 
                     for y in j_witnesses:
                         diff = j - round_index

@@ -40,6 +40,59 @@ def sharded_strongly_see(mesh: Mesh, super_majority: int):
     )
 
 
+def ring_strongly_see(mesh: Mesh, super_majority: int):
+    """stronglySee with BOTH coordinate tensors sharded and NO all-gather:
+    first-descendant blocks rotate around the device ring (``ppermute``)
+    while each chip accumulates compare-counts for its local
+    last-ancestor rows — ring attention's KV-rotation pattern applied to
+    the consensus window (KV blocks ≙ first-descendant blocks, queries ≙
+    last-ancestor rows; SURVEY.md §2.5/§5 CP mapping).
+
+    Versus ``sharded_strongly_see``'s all-gather, peak per-chip live
+    memory drops from O(E·P) to O(E·P/n), and each of the n steps moves
+    one block over a single ICI hop, overlappable with the block compare.
+    Requires a 1-D mesh (``mesh.ring_mesh``). Returns a function
+    (la [E, P] row-sharded, fd [E, P] row-sharded) -> ss [E, E]
+    row-sharded, bit-identical to the all-gather kernel.
+    """
+    n = mesh.devices.size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def kernel(la_local, fd_block):
+        e_loc = la_local.shape[0]
+        me = lax.axis_index("ring")
+
+        def compare(out, fd_blk, src):
+            ge = la_local[:, None, :] >= fd_blk[None, :, :]
+            counts = jnp.sum(ge, axis=-1, dtype=jnp.int32)
+            return lax.dynamic_update_slice(
+                out, counts >= super_majority, (0, src * e_loc)
+            )
+
+        # local block first, then n-1 rotations: after s forward rotations
+        # this chip holds the block that started on shard (me - s) mod n
+        out0 = compare(
+            jnp.zeros((e_loc, e_loc * n), bool), fd_block, me
+        )
+
+        def step(s, state):
+            fd_blk, out = state
+            fd_blk = lax.ppermute(fd_blk, "ring", perm)
+            out = compare(out, fd_blk, (me - s) % n)
+            return fd_blk, out
+
+        _, out = lax.fori_loop(1, n, step, (fd_block, out0))
+        return out
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P("ring", None), P("ring", None)),
+        out_specs=P("ring", None),
+        check_vma=False,
+    )
+
+
 def sharded_vote_counts(mesh: Mesh):
     """Super-majority vote tally with voters sharded across chips.
 

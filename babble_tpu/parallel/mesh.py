@@ -39,6 +39,16 @@ def consensus_mesh(
     return Mesh(mesh_devices, axis_names=("dp", "sp"))
 
 
+def ring_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over all (or the first n) devices with a single ``ring``
+    axis — the topology for the ppermute-based ring kernels, where blocks
+    rotate neighbour-to-neighbour instead of all-gathering."""
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    return Mesh(np.array(devices[:n_devices]), axis_names=("ring",))
+
+
 def shard_batched_snapshot(mesh: Mesh, arrays: Tuple):
     """Place a batch of snapshot tensors on the mesh: batch dim over ``dp``,
     event dim over ``sp``, peer dim replicated.

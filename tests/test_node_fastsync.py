@@ -148,6 +148,64 @@ def test_catch_up():
             late.shutdown()
 
 
+def test_fast_sync_recycled_participant():
+    """A node that participated, shut down, and lost its store rejoins via
+    fast-sync while the cluster keeps committing (reference:
+    node_fastsync_test.go:114-170 TestFastSync — recycleNode hands inmem
+    nodes a FRESH store, node_test.go:472-489, so the rejoin exercises the
+    CatchingUp path, not bootstrap)."""
+    network = InmemNetwork()
+    build = make_lazy_cluster(4, network)
+    quads = [build(i, enable_fast_sync=True) for i in range(4)]
+    nodes = [n for n, _ in quads]
+    proxies = [p for _, p in quads]
+    bomb = Bombardier(proxies).start()
+    recycled = None
+    try:
+        for n in nodes:
+            n.run_async()
+        wait_until(
+            lambda: anchor_exists(nodes)
+            and min(n.get_last_block_index() for n in nodes) >= 2,
+            60.0,
+            "cluster never reached block 2 with an anchor",
+        )
+
+        # node0 dies; the other three keep committing
+        nodes[0].shutdown()
+        survivors, sproxies = nodes[1:], proxies[1:]
+        second_target = max(n.get_last_block_index() for n in survivors) + 2
+        wait_until(
+            lambda: min(n.get_last_block_index() for n in survivors)
+            >= second_target,
+            60.0,
+            "survivors stalled after node0 shutdown",
+        )
+
+        # recycle node0: same key and address, FRESH empty store
+        recycled, rproxy = build(0, enable_fast_sync=True)
+        assert recycled.get_state() == State.CATCHING_UP
+        recycled.run_async()
+        wait_until(
+            lambda: recycled.get_state() == State.BABBLING
+            and recycled.get_last_block_index() >= second_target,
+            60.0,
+            "recycled node never caught back up",
+        )
+        rejoin_block = recycled.get_last_block_index()
+        bomb.stop()
+
+        everyone = survivors + [recycled]
+        target = max(n.get_last_block_index() for n in everyone) + 2
+        bombard_and_wait(everyone, sproxies + [rproxy], target, timeout=90.0)
+        check_gossip(everyone, max(rejoin_block, 1), target)
+    finally:
+        bomb.stop()
+        shutdown_all(nodes)
+        if recycled is not None:
+            recycled.shutdown()
+
+
 def test_auto_suspend_still_answers_syncs():
     """Only 2 of 3 validators run, so consensus can never complete and
     undetermined events pile up past suspend_limit * n_validators; both

@@ -43,6 +43,34 @@ def test_sharded_vote_counts_matches_numpy():
     np.testing.assert_array_equal(got, want)
 
 
+def test_ring_strongly_see_matches_all_gather_kernel():
+    """The ppermute ring formulation (blocks rotating neighbour-to-
+    neighbour) is bit-identical to the all-gather formulation and to
+    plain numpy — on coordinates from a real hashgraph window."""
+    from babble_tpu.parallel.collectives import (
+        ring_strongly_see,
+        sharded_strongly_see,
+    )
+    from babble_tpu.parallel.mesh import consensus_mesh, ring_mesh
+    from babble_tpu.parallel.voting_shard import synthetic_voting_window
+
+    _, win = synthetic_voting_window(n_peers=6, n_events=160,
+                                     peer_change=False)
+    # pad the witness axis to a multiple of 8 for the row sharding
+    la = np.asarray(win.la_w)
+    fd = np.asarray(win.fd_w)
+    pad = (-la.shape[0]) % 8
+    la = np.pad(la, ((0, pad), (0, 0)))
+    fd = np.pad(fd, ((0, pad), (0, 0)), constant_values=np.iinfo(np.int32).max)
+    sm = int(np.asarray(win.sm_s).max())
+
+    want = (la[:, None, :] >= fd[None, :, :]).sum(-1) >= sm
+    got_ring = np.asarray(ring_strongly_see(ring_mesh(8), sm)(la, fd))
+    got_ag = np.asarray(sharded_strongly_see(consensus_mesh(8), sm)(la, fd))
+    np.testing.assert_array_equal(got_ring, want)
+    np.testing.assert_array_equal(got_ag, want)
+
+
 def test_sharded_live_voting_sweep_matches_single_device():
     """The LIVE voting kernel (ops.voting fused sweep) sharded over the
     witness axis on an 8-device mesh returns bit-identical fame and

@@ -1,0 +1,167 @@
+"""InmemStore behavior suite.
+
+Modeled on the reference's inmem_store_test.go
+(/root/reference/src/hashgraph/inmem_store_test.go:37-271 —
+TestInmemEvents / TestInmemRounds / TestInmemBlocks) plus the rolling-window
+eviction semantics from common/rolling_index.go that make the inmem store
+unfit for full-history sync (inmem_store.go:14-48).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from babble_tpu.common.errors import StoreError, StoreErrorKind
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.hashgraph.block import Block, BlockSignature
+from babble_tpu.hashgraph.event import Event
+from babble_tpu.hashgraph.internal_transaction import InternalTransaction
+from babble_tpu.hashgraph.round_info import RoundInfo
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+
+
+def init_store(n: int = 3, cache_size: int = 100):
+    keys = [generate_key() for _ in range(n)]
+    peers = PeerSet(
+        [
+            Peer(f"inmem://s{i}", k.public_key.hex(), f"s{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    store = InmemStore(cache_size)
+    store.set_peer_set(0, peers)
+    key_of = {k.public_key.hex(): k for k in keys}
+    return store, peers, [key_of[p.pub_key_hex] for p in peers.peers]
+
+
+def test_inmem_events_round_trip_and_participant_caches():
+    """Events round-trip; ParticipantEvents preserves insertion order;
+    KnownEvents maps peer id -> last index (TestInmemEvents)."""
+    test_size = 15
+    store, peers, keys = init_store()
+    events: dict = {}
+    for p, k in zip(peers.peers, keys):
+        items = []
+        for i in range(test_size):
+            e = Event.new(
+                [f"{p.pub_key_hex[:5]}_{i}".encode()],
+                [],
+                [BlockSignature(b"validator", 0, "r|s")],
+                ["", ""],
+                k.public_key.bytes(),
+                i,
+            )
+            items.append(e)
+            store.set_event(e)
+        events[p.pub_key_hex] = items
+
+    for p_hex, items in events.items():
+        for e in items:
+            got = store.get_event(e.hex())
+            assert got.body.hash() == e.body.hash()
+
+    for p in peers.peers:
+        p_events = store.participant_events(p.pub_key_hex, -1)
+        assert len(p_events) == test_size
+        assert p_events == [e.hex() for e in events[p.pub_key_hex]]
+        # by-index lookup and last-event agree with the list
+        assert store.participant_event(p.pub_key_hex, 3) == p_events[3]
+        assert store.last_event_from(p.pub_key_hex) == p_events[-1]
+
+    assert store.known_events() == {
+        p.id: test_size - 1 for p in peers.peers
+    }
+
+
+def test_inmem_consensus_events_ordering():
+    """AddConsensusEvent tracks count and last-consensus-event per creator
+    (TestInmemEvents 'Add ConsensusEvents' + inmem_store.go:154-157)."""
+    store, peers, keys = init_store()
+    assert store.consensus_events_count() == 0
+    assert store.last_consensus_event_from(peers.peers[0].pub_key_hex) == ""
+    total = 0
+    for p, k in zip(peers.peers, keys):
+        for i in range(5):
+            e = Event.new([b"c"], [], [], ["", ""], k.public_key.bytes(), i)
+            store.set_event(e)
+            store.add_consensus_event(e)
+            total += 1
+            assert store.last_consensus_event_from(p.pub_key_hex) == e.hex()
+    assert store.consensus_events_count() == total
+    assert len(store.consensus_events()) == total
+
+
+def test_inmem_rounds():
+    """Round round-trip, LastRound, RoundWitnesses (TestInmemRounds)."""
+    store, peers, keys = init_store()
+    ri = RoundInfo()
+    hashes = []
+    for k in keys:
+        e = Event.new([], [], [], ["", ""], k.public_key.bytes(), 0)
+        ri.add_created_event(e.hex(), True)
+        hashes.append(e.hex())
+    store.set_round(0, ri)
+
+    got = store.get_round(0)
+    assert set(got.witnesses()) == set(hashes)
+    assert store.last_round() == 0
+    assert set(store.round_witnesses(0)) == set(hashes)
+    assert store.round_events(0) == len(hashes)
+    # unknown round: KEY_NOT_FOUND, and witness helpers degrade to empty
+    with pytest.raises(StoreError) as err:
+        store.get_round(5)
+    assert err.value.kind == StoreErrorKind.KEY_NOT_FOUND
+    assert store.round_witnesses(5) == []
+    assert store.round_events(5) == 0
+
+
+def test_inmem_blocks_with_signatures():
+    """A signed block round-trips with both validator signatures intact and
+    verifiable (TestInmemBlocks)."""
+    store, peers, keys = init_store()
+    itxs = [
+        InternalTransaction.join(Peer("paris", "0xBAAAAAAAD", "")),
+        InternalTransaction.leave(Peer("london", "0xB16B00B5", "")),
+    ]
+    block = Block.new(
+        0, 7, b"this is the frame hash", peers,
+        [b"tx1", b"tx2", b"tx3", b"tx4", b"tx5"], itxs, 0,
+    )
+    sig1 = block.sign(keys[0])
+    sig2 = block.sign(keys[1])
+    block.set_signature(sig1)
+    block.set_signature(sig2)
+
+    store.set_block(block)
+    got = store.get_block(0)
+    assert got.body.hash() == block.body.hash()
+    assert store.last_block_index() == 0
+
+    assert got.signatures[peers.peers[0].pub_key_hex] == sig1.signature
+    assert got.signatures[peers.peers[1].pub_key_hex] == sig2.signature
+    assert got.verify_signature(sig1) and got.verify_signature(sig2)
+
+    with pytest.raises(StoreError):
+        store.get_block(1)
+
+
+def test_inmem_rolling_window_eviction_too_late():
+    """Indexes that fell out of the rolling window raise TOO_LATE, not
+    KEY_NOT_FOUND — the semantics that make the inmem store unfit for
+    full-history sync (rolling_index.go:8-110, store_errors.go:8-41)."""
+    store, peers, keys = init_store(n=1, cache_size=10)
+    p_hex = peers.peers[0].pub_key_hex
+    for i in range(25):
+        e = Event.new([], [], [], ["", ""], keys[0].public_key.bytes(), i)
+        store.set_event(e)
+    # the early indexes were evicted by the FIFO roll
+    with pytest.raises(StoreError) as err:
+        store.participant_event(p_hex, 0)
+    assert err.value.kind == StoreErrorKind.TOO_LATE
+    with pytest.raises(StoreError):
+        store.participant_events(p_hex, -1)
+    # recent indexes survive
+    assert store.participant_event(p_hex, 24)
+    assert store.known_events()[peers.peers[0].id] == 24
