@@ -172,8 +172,15 @@ class TensorConsensus:
 
     def invalidate(self) -> None:
         """Drop any in-flight sweep (hashgraph reset / fast-sync landing):
-        its snapshot no longer describes this store."""
+        its snapshot no longer describes this store. Reclaim its admission
+        slot — dropping the reference would lose the timeout-reclaim path,
+        and a wedged readback would then leak the slot forever. If the
+        readback is merely slow, the device is briefly over-admitted by
+        one sweep; the reader's own eventual release is a no-op."""
         self.generation += 1
+        inf = self._inflight
+        if inf is not None:
+            inf.release_slot()
         self._inflight = None
         self._last_snapshot_topo = -1
 
@@ -267,6 +274,7 @@ class TensorConsensus:
         inf = self._inflight
         if inf is not None:
             if inf.generation != self.generation:
+                inf.release_slot()  # same reclaim rationale as invalidate()
                 self._inflight = None
             elif not inf.done.is_set():
                 if (
@@ -496,12 +504,17 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
         (64, 512, P, S, 16),
         (128, 512, P, S, 16),
         (128, 1024, P, S, 16),
+    ]
+    if n_peers >= 12:
         # sustained backlogs at 16+ validators accumulate rounds past the
         # R=16 bucket before decisions drain; compiling R=32 up front keeps
-        # mid-run compiles (and their single-core steal) off the bench path
-        (128, 1024, P, S, 32),
-        (256, 1024, P, S, 32),
-    ]
+        # mid-run compiles (and their single-core steal) off the bench
+        # path. Small clusters never hit these shapes — skipping them
+        # keeps their prewarm cheap.
+        buckets += [
+            (128, 1024, P, S, 32),
+            (256, 1024, P, S, 32),
+        ]
 
     def work() -> None:
         for key in buckets:

@@ -84,6 +84,13 @@ class Hashgraph:
         self.store = store
         # FIFO of events whose consensus order is not yet determined.
         self.undetermined_events: List[str] = []
+        # Subset of undetermined_events still awaiting round/lamport
+        # assignment — only fresh inserts land here, so divide_rounds scans
+        # the new tail instead of re-fetching the whole backlog (the
+        # reference rescans UndeterminedEvents, hashgraph.go:807-812; the
+        # skip condition there is exactly "round and lamport already set",
+        # which for us is "not in this list").
+        self._round_pending: List[str] = []
         self.pending_rounds = PendingRoundsCache()
         self.pending_signatures = SigPool()
         self.last_consensus_round: Optional[int] = None
@@ -464,6 +471,7 @@ class Hashgraph:
         self._update_ancestor_first_descendant(event)
 
         self.undetermined_events.append(event.hex())
+        self._round_pending.append(event.hex())
 
         if event.is_loaded():
             self.pending_loaded_events += 1
@@ -504,42 +512,61 @@ class Hashgraph:
 
     def divide_rounds(self) -> None:
         """Assign round + Lamport timestamp to undetermined events, flag
-        witnesses, queue pending rounds (reference: hashgraph.go:807-872)."""
-        for hash_ in self.undetermined_events:
-            ev = self.store.get_event(hash_)
-            update_event = False
+        witnesses, queue pending rounds (reference: hashgraph.go:807-872).
 
-            if ev.round is None:
-                round_number = self.round(hash_)
-                ev.set_round(round_number)
-                update_event = True
+        Scans only the fresh-insert tail (_round_pending): already-assigned
+        events can never need reassignment, so re-fetching the full
+        undetermined backlog per pass (the reference's loop shape) would be
+        pure store/LRU overhead. On error the unprocessed suffix is
+        requeued so the next pass retries it."""
+        pending = self._round_pending
+        if not pending:
+            return
+        self._round_pending = []
+        done = 0
+        try:
+            for hash_ in pending:
+                self._assign_round_and_lamport(hash_)
+                done += 1
+        except BaseException:
+            self._round_pending = pending[done:] + self._round_pending
+            raise
 
-                try:
-                    round_info = self.store.get_round(round_number)
-                except StoreError as err:
-                    if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
-                        raise
-                    round_info = RoundInfo()
+    def _assign_round_and_lamport(self, hash_: str) -> None:
+        ev = self.store.get_event(hash_)
+        update_event = False
 
-                if (
-                    not self.pending_rounds.queued(round_number)
-                    and not round_info.decided
-                    and (
-                        self.round_lower_bound is None
-                        or round_number > self.round_lower_bound
-                    )
-                ):
-                    self.pending_rounds.set(PendingRound(round_number, False))
+        if ev.round is None:
+            round_number = self.round(hash_)
+            ev.set_round(round_number)
+            update_event = True
 
-                round_info.add_created_event(hash_, self.witness(hash_))
-                self.store.set_round(round_number, round_info)
+            try:
+                round_info = self.store.get_round(round_number)
+            except StoreError as err:
+                if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
+                    raise
+                round_info = RoundInfo()
 
-            if ev.lamport_timestamp is None:
-                ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
-                update_event = True
+            if (
+                not self.pending_rounds.queued(round_number)
+                and not round_info.decided
+                and (
+                    self.round_lower_bound is None
+                    or round_number > self.round_lower_bound
+                )
+            ):
+                self.pending_rounds.set(PendingRound(round_number, False))
 
-            if update_event:
-                self.store.set_event(ev)
+            round_info.add_created_event(hash_, self.witness(hash_))
+            self.store.set_round(round_number, round_info)
+
+        if ev.lamport_timestamp is None:
+            ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
+            update_event = True
+
+        if update_event:
+            self.store.set_event(ev)
 
     def decide_fame(self) -> None:
         """Virtual voting with coin rounds every COIN_ROUND_FREQ rounds
@@ -911,6 +938,7 @@ class Hashgraph:
         self.first_consensus_round = None
         self.anchor_block = None
         self.undetermined_events = []
+        self._round_pending = []
         self.pending_rounds = PendingRoundsCache()
         self.pending_loaded_events = 0
         self.topological_index = 0

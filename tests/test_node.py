@@ -203,6 +203,75 @@ def test_gossip_mixed_accelerated_and_oracle_nodes():
         shutdown_all(nodes)
 
 
+def test_add_transaction_rides_next_head():
+    """A submitted transaction leaves the pool and lands in the node's next
+    self-event (reference: node_test.go:39-98 TestAddTransaction)."""
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(2, network)
+    try:
+        # only the RESPONDER runs (background work, no gossip timer —
+        # reference RunAsync(false)); node0 is driven by hand, so its own
+        # background worker can't race us for the submit queue
+        nodes[1].run_async(gossip=False)
+        message = b"Hello World!"
+        proxies[0].submit_tx(message)
+        # drain the proxy's submit channel into the node's pool the way the
+        # background worker would (node.py doBackgroundWork loop)
+        nodes[0]._add_transaction(nodes[0].submit_q.get(timeout=1))
+        with nodes[0].core_lock:
+            known = nodes[0].core.known_events()
+        peer1 = next(
+            p for p in nodes[0].core.peers.peers
+            if p.id != nodes[0].get_id()
+        )
+        resp = nodes[0]._request_sync(peer1.net_addr, known, 500)
+        with nodes[0].core_lock:
+            nodes[0]._sync(peer1.id, resp.events)
+
+        assert len(nodes[0].core.transaction_pool) == 0
+        head = nodes[0].core.get_head()
+        assert head.transactions() == [message]
+    finally:
+        shutdown_all(nodes)
+
+
+def test_shutdown_peer_unreachable():
+    """Gossiping with a shut-down peer fails and marks it disconnected
+    (reference: node_test.go:222-236 TestShutdown)."""
+    from babble_tpu.net.transport import TransportError
+
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(4, network)
+    try:
+        for n in nodes:
+            n.run_async()
+        nodes[0].shutdown()
+        peer0 = next(
+            p for p in nodes[1].core.peers.peers if p.id == nodes[0].get_id()
+        )
+        with pytest.raises(TransportError):
+            nodes[1]._pull(peer0)
+        # the outer gossip wrapper swallows the error but flags the peer
+        nodes[1]._gossip(peer0)
+        assert nodes[1].core.peer_selector._connected[peer0.id] is False
+    finally:
+        shutdown_all(nodes)
+
+
+def test_monologue_single_node_commits():
+    """A single-validator network babbles with itself and still commits
+    blocks (reference: node_dyn_test.go:20-35 TestMonologue)."""
+    network = InmemNetwork()
+    nodes, proxies, states = make_cluster(1, network)
+    try:
+        nodes[0].run_async()
+        bombard_and_wait(nodes, proxies, target_block=3, timeout=60.0)
+        check_gossip(nodes, 0, 3)
+        check_timestamps(nodes, 3)
+    finally:
+        shutdown_all(nodes)
+
+
 def test_missing_node_gossip():
     """Gossip converges with one of 4 nodes down
     (reference: node_test.go:166-181)."""
