@@ -69,25 +69,86 @@ class _Inflight:
             self._slots.release()
 
 
-# Process-wide sweep admission control. Co-located nodes (multi-validator
-# hosts, the 16-node bench, tests) share ONE device and ONE tunnel; without
-# a cap their redundant sweeps convoy on the readback path and per-sweep
-# latency balloons from ~100 ms to 600+ ms. Capping in-flight sweeps keeps
-# device latency flat; flushes that lose the race ride the oracle, which is
+# Sweep admission control. Co-located nodes (multi-validator hosts, the
+# 16-node bench, tests) share ONE device and ONE tunnel; without a cap
+# their redundant sweeps convoy on the readback path and per-sweep latency
+# balloons from ~100 ms to 600+ ms. Capping in-flight sweeps keeps device
+# latency flat; flushes that lose the race ride the oracle, which is
 # exactly the small-window economics already encoded in min_window.
-_INFLIGHT_SLOTS: Optional[threading.Semaphore] = None
+#
+# Two scopes:
+# - in-process (default): a plain semaphore covers threads in one
+#   interpreter (threaded clusters, tests);
+# - cross-process (BABBLE_ACCEL_SLOT_DIR): flock-guarded slot files, so
+#   independent node PROCESSES on one host coordinate too — per-process
+#   semaphores can't see each other, and 4 processes x 2 slots would put
+#   8 sweeps in flight on one device.
+
+
+class _FlockSlots:
+    """Semaphore-shaped admission slots shared ACROSS processes via
+    non-blocking flock on a fixed set of slot files. Locks die with the
+    process, so a crashed node can never leak a slot."""
+
+    def __init__(self, dir_path: str, n: int):
+        import os
+
+        os.makedirs(dir_path, exist_ok=True)
+        self._paths = [
+            os.path.join(dir_path, f"sweep-slot-{i}.lock") for i in range(n)
+        ]
+        self._lock = threading.Lock()
+        self._held: list = []  # (path, fd) LIFO
+
+    def acquire(self, blocking: bool = False) -> bool:
+        import fcntl
+        import os
+
+        assert not blocking, "admission slots are try-acquire only"
+        with self._lock:
+            held_paths = {p for p, _ in self._held}
+            for p in self._paths:
+                if p in held_paths:
+                    continue
+                fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    os.close(fd)
+                    continue
+                self._held.append((p, fd))
+                return True
+            return False
+
+    def release(self) -> None:
+        import fcntl
+        import os
+
+        with self._lock:
+            if not self._held:
+                return
+            _, fd = self._held.pop()
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+_INFLIGHT_SLOTS = None
 _slots_lock = threading.Lock()
 
 
-def _inflight_slots() -> threading.Semaphore:
+def _inflight_slots():
     global _INFLIGHT_SLOTS
     if _INFLIGHT_SLOTS is None:
         with _slots_lock:
             if _INFLIGHT_SLOTS is None:
                 import os
 
-                n = int(os.environ.get("BABBLE_ACCEL_MAX_INFLIGHT", "2"))
-                _INFLIGHT_SLOTS = threading.Semaphore(max(1, n))
+                n = max(1, int(os.environ.get("BABBLE_ACCEL_MAX_INFLIGHT", "2")))
+                slot_dir = os.environ.get("BABBLE_ACCEL_SLOT_DIR")
+                if slot_dir:
+                    _INFLIGHT_SLOTS = _FlockSlots(slot_dir, n)
+                else:
+                    _INFLIGHT_SLOTS = threading.Semaphore(n)
     return _INFLIGHT_SLOTS
 
 
@@ -352,8 +413,16 @@ class TensorConsensus:
         # Admission control covers only actual device occupancy — the
         # host-side window build above runs slot-free so co-located nodes
         # aren't starved during work that never touches the device.
-        slots = _inflight_slots()
-        if not slots.acquire(blocking=False):
+        try:
+            slots = _inflight_slots()
+            acquired = slots.acquire(blocking=False)
+        except OSError as err:
+            # _FlockSlots.acquire opens slot files; a vanished slot dir or
+            # fd exhaustion must degrade to the oracle like every other
+            # failure in this module, never kill the gossip path.
+            self._note_fallback(err)
+            return False
+        if not acquired:
             # Device already at max in-flight sweeps (co-located nodes
             # share it): let the oracle carry this flush instead of
             # joining a readback convoy.

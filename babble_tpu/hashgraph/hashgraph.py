@@ -537,16 +537,20 @@ class Hashgraph:
         update_event = False
 
         if ev.round is None:
+            # All fallible reads (round, round-info, witness) run BEFORE the
+            # event is mutated: the store hands back this same cached object,
+            # so mutating first would make the requeued retry see
+            # "round already assigned" and skip witness registration forever.
             round_number = self.round(hash_)
-            ev.set_round(round_number)
-            update_event = True
-
             try:
                 round_info = self.store.get_round(round_number)
             except StoreError as err:
                 if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
                     raise
                 round_info = RoundInfo()
+            is_witness = self.witness(hash_)
+            ev.set_round(round_number)
+            update_event = True
 
             if (
                 not self.pending_rounds.queued(round_number)
@@ -558,11 +562,13 @@ class Hashgraph:
             ):
                 self.pending_rounds.set(PendingRound(round_number, False))
 
-            round_info.add_created_event(hash_, self.witness(hash_))
+            round_info.add_created_event(hash_, is_witness)
             self.store.set_round(round_number, round_info)
 
         if ev.lamport_timestamp is None:
-            ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
+            # fallible read evaluated before the mutation, same rationale
+            lt = self.lamport_timestamp(hash_)
+            ev.set_lamport_timestamp(lt)
             update_event = True
 
         if update_event:

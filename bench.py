@@ -506,7 +506,12 @@ def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
                    # A dead TPU tunnel must cost one short probe, not wedge
                    # sixteen child processes for minutes.
                    "BABBLE_DEVICE_PROBE_TIMEOUT": os.environ.get(
-                       "BABBLE_DEVICE_PROBE_TIMEOUT", "20")}
+                       "BABBLE_DEVICE_PROBE_TIMEOUT", "20"),
+                   # One admission-control domain for ALL child nodes:
+                   # per-process semaphores can't see each other, and n
+                   # processes x 2 slots would convoy n*2 sweeps on the
+                   # single device (accel.py _FlockSlots).
+                   "BABBLE_ACCEL_SLOT_DIR": os.path.join(tmp, "slots")}
             procs.append(subprocess.Popen(
                 cmd,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -730,9 +735,12 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
                     for k in (
                         "accel_sweeps", "accel_avg_sweep_ms",
                         "accel_last_window_events", "accel_compile_waits",
-                        "accel_small_windows",
+                        "accel_small_windows", "accel_contended",
                     )
                 },
+                "accel_contended_total": sum(
+                    int(s.get("accel_contended") or 0) for s in all_stats
+                ),
             }
         return rate, stats
     finally:

@@ -186,3 +186,63 @@ def test_accel_stats_surface():
     assert s["accel_sweeps"] >= 1
     assert s["accel_last_window_events"] > 0
     assert s["accel_avg_sweep_ms"] > 0
+
+
+def test_flock_slots_cross_process_exclusion(tmp_path):
+    """BABBLE_ACCEL_SLOT_DIR admission slots exclude across PROCESSES:
+    with 2 slot files, two holders in a child process leave none for this
+    one; releases hand them back (accel.py _FlockSlots)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from babble_tpu.hashgraph.accel import _FlockSlots
+
+    slot_dir = str(tmp_path / "slots")
+    mine = _FlockSlots(slot_dir, 2)
+
+    # a child process grabs both slots and holds them until told to exit
+    child = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import sys
+            from babble_tpu.hashgraph.accel import _FlockSlots
+            s = _FlockSlots({slot_dir!r}, 2)
+            assert s.acquire() and s.acquire()
+            print("held", flush=True)
+            sys.stdin.readline()  # wait for the parent
+            s.release()
+            print("one-free", flush=True)
+            sys.stdin.readline()
+        """)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        cwd="/root/repo",
+    )
+    try:
+        assert child.stdout.readline().strip() == "held"
+        assert mine.acquire() is False, "child's flocks not visible"
+
+        child.stdin.write("\n")
+        child.stdin.flush()
+        assert child.stdout.readline().strip() == "one-free"
+        assert mine.acquire() is True, "released slot not acquirable"
+        assert mine.acquire() is False, "child still holds the other slot"
+        mine.release()
+    finally:
+        child.stdin.close()
+        child.wait(timeout=10)
+
+
+def test_flock_slots_thread_exclusion(tmp_path):
+    """The same slot files exclude across threads of ONE process too (each
+    acquire opens its own fd; Linux flock treats separate fds as
+    independent lockers)."""
+    from babble_tpu.hashgraph.accel import _FlockSlots
+
+    s = _FlockSlots(str(tmp_path / "slots"), 2)
+    assert s.acquire() and s.acquire()
+    assert s.acquire() is False
+    s.release()
+    assert s.acquire() is True
+    s.release()
+    s.release()
+    s.release()  # over-release is a no-op

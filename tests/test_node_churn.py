@@ -42,6 +42,67 @@ def check_peer_sets(nodes, timeout: float = 30.0):
     )
 
 
+def test_join_late_after_history():
+    """A brand-new validator joins a cluster that has already committed
+    substantial history: it must be accepted through consensus, catch up,
+    and participate; every node records the enlarged peer-set at the
+    accepted round (reference: node_extra_test.go:30-76 TestJoinLateExtra,
+    verifyNewPeerSet)."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(4, network)
+    genesis = nodes[0].core.genesis_peers
+    joiner = None
+    try:
+        for n in nodes:
+            n.run_async()
+        # build real history before the join
+        bombard_and_wait(nodes, proxies, target_block=4, timeout=90.0)
+
+        joiner, jproxy = make_extra_node(
+            network, nodes[0].core.peers, genesis, "monika"
+        )
+        assert joiner.get_state() == State.JOINING
+        joiner.run_async()
+        bomb = Bombardier(proxies).start()
+        try:
+            wait_until(
+                lambda: joiner.get_state() == State.BABBLING,
+                90.0,
+                "late joiner never reached BABBLING",
+            )
+            everyone = nodes + [joiner]
+            check_peer_sets(everyone, timeout=60.0)
+        finally:
+            bomb.stop()
+
+        # keep committing with all five and compare chains from the round
+        # where the joiner's history begins
+        target = max(n.get_last_block_index() for n in nodes) + 2
+        bombard_and_wait(everyone, proxies + [jproxy], target, timeout=90.0)
+        first = joiner.core.hg.first_consensus_round or 0
+        start_block = next(
+            bi
+            for bi in range(target + 1)
+            if nodes[0].get_block(bi).round_received() >= first
+        )
+        check_gossip(everyone, max(start_block, 1), target)
+
+        # the 5-peer set is recorded at the joiner's accepted round on
+        # every original node (reference: verifyNewPeerSet)
+        accepted = joiner.core.accepted_round
+        assert accepted > 0
+        for n in nodes:
+            ps = n.core.hg.store.get_peer_set(accepted)
+            assert len(ps.peers) == 5, (
+                f"node {n.get_id()} peer-set at round {accepted}: "
+                f"{len(ps.peers)}"
+            )
+    finally:
+        shutdown_all(nodes)
+        if joiner is not None:
+            joiner.shutdown()
+
+
 def test_successive_joins():
     """Three nodes join a 1-node cluster one after another; after each
     join every node holds the same chain and peer-set
