@@ -14,6 +14,18 @@ import json
 from typing import Any
 
 
+class PreNormalized:
+    """Wrapper marking a value as ALREADY normalized (b64 applied, plain
+    str/int/dict/list all the way down). _normalize passes it through
+    untouched — the hook that lets hot senders (event push paths) memoize
+    an object's normalized form instead of re-walking it per send."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
 def _normalize(obj: Any) -> Any:
     # exact-type fast path ordered by frequency (leaves dominate): this
     # walk runs for every event hash on the insert hot path. Subclasses
@@ -23,6 +35,8 @@ def _normalize(obj: Any) -> Any:
     t = type(obj)
     if t is str or t is int:
         return obj
+    if t is PreNormalized:
+        return obj.value
     if t is bytes or t is bytearray:
         return base64.b64encode(bytes(obj)).decode("ascii")
     if t is dict:

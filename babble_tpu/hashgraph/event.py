@@ -173,6 +173,7 @@ class Event:
         "_hash",
         "_hex",
         "_sig_ok",
+        "_wire",
     )
 
     def __init__(self, body: EventBody, signature: str = ""):
@@ -188,6 +189,7 @@ class Event:
         self._hash: bytes = b""
         self._hex: str = ""
         self._sig_ok: Optional[bool] = None
+        self._wire: Optional["WireEvent"] = None
 
     @staticmethod
     def new(
@@ -264,12 +266,14 @@ class Event:
         self._hex = ""
         self._creator = ""
         self._sig_ok = None
+        self._wire = None
 
     # -- signatures --------------------------------------------------------
 
     def sign(self, key: PrivateKey) -> None:
         """reference: event.go:201-215."""
         self.signature = key.sign(self.hash())
+        self._wire = None  # wire form carries the signature
 
     def verify(self) -> bool:
         """Verify the creator's signature AND every internal transaction's
@@ -316,6 +320,7 @@ class Event:
         self.body.other_parent_creator_id = other_parent_creator_id
         self.body.other_parent_index = other_parent_index
         self.body.creator_id = creator_id
+        self._wire = None  # wire form depends on the ids set here
 
     # -- wire --------------------------------------------------------------
 
@@ -323,21 +328,28 @@ class Event:
         return [bs.to_wire() for bs in self.body.block_signatures]
 
     def to_wire(self) -> "WireEvent":
-        """reference: event.go:390-405."""
-        return WireEvent(
-            body=WireBody(
-                transactions=list(self.body.transactions),
-                internal_transactions=list(self.body.internal_transactions),
-                block_signatures=self.wire_block_signatures(),
-                creator_id=self.body.creator_id,
-                other_parent_creator_id=self.body.other_parent_creator_id,
-                index=self.body.index,
-                self_parent_index=self.body.self_parent_index,
-                other_parent_index=self.body.other_parent_index,
-                timestamp=self.body.timestamp,
-            ),
-            signature=self.signature,
-        )
+        """reference: event.go:390-405.
+
+        Cached: the same immutable event is pushed to many peers, and the
+        shared WireEvent also memoizes its normalized (base64-applied)
+        encoding, so per-transaction b64 work happens once per event
+        instead of once per send (set_wire_info invalidates)."""
+        if self._wire is None:
+            self._wire = WireEvent(
+                body=WireBody(
+                    transactions=list(self.body.transactions),
+                    internal_transactions=list(self.body.internal_transactions),
+                    block_signatures=self.wire_block_signatures(),
+                    creator_id=self.body.creator_id,
+                    other_parent_creator_id=self.body.other_parent_creator_id,
+                    index=self.body.index,
+                    self_parent_index=self.body.self_parent_index,
+                    other_parent_index=self.body.other_parent_index,
+                    timestamp=self.body.timestamp,
+                ),
+                signature=self.signature,
+            )
+        return self._wire
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"Event({self.creator()[:10]}:{self.index()} {self.hex()[:10]})"
@@ -413,6 +425,19 @@ class WireEvent:
 
     def to_dict(self) -> dict:
         return {"Body": self.body.to_dict(), "Signature": self.signature}
+
+    def normalized(self) -> dict:
+        """Canonically normalized to_dict (bytes already base64), memoized:
+        Event.to_wire shares one WireEvent per event, so each event's
+        transactions are b64-encoded once total rather than once per peer
+        it is pushed to."""
+        n = getattr(self, "_norm", None)
+        if n is None:
+            from babble_tpu.crypto.canonical import _normalize
+
+            n = _normalize(self.to_dict())
+            self._norm = n
+        return n
 
     @staticmethod
     def from_dict(d: dict) -> "WireEvent":

@@ -14,6 +14,7 @@ import queue
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..crypto.canonical import PreNormalized
 from ..hashgraph.block import Block
 from ..hashgraph.event import WireEvent
 from ..hashgraph.frame import Frame
@@ -64,7 +65,9 @@ class SyncResponse:
     def to_dict(self) -> dict:
         return {
             "from_id": self.from_id,
-            "events": [e.to_dict() for e in self.events],
+            # memoized normalized form: each event's bytes are b64'd once
+            # per process, not once per peer pushed to (event.py normalized)
+            "events": [PreNormalized(e.normalized()) for e in self.events],
             "known": {str(k): v for k, v in self.known.items()},
         }
 
@@ -88,7 +91,7 @@ class EagerSyncRequest:
     def to_dict(self) -> dict:
         return {
             "from_id": self.from_id,
-            "events": [e.to_dict() for e in self.events],
+            "events": [PreNormalized(e.normalized()) for e in self.events],
         }
 
     @staticmethod

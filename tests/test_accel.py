@@ -192,6 +192,7 @@ def test_flock_slots_cross_process_exclusion(tmp_path):
     """BABBLE_ACCEL_SLOT_DIR admission slots exclude across PROCESSES:
     with 2 slot files, two holders in a child process leave none for this
     one; releases hand them back (accel.py _FlockSlots)."""
+    import os
     import subprocess
     import sys
     import textwrap
@@ -215,7 +216,7 @@ def test_flock_slots_cross_process_exclusion(tmp_path):
             sys.stdin.readline()
         """)],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
-        cwd="/root/repo",
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     try:
         assert child.stdout.readline().strip() == "held"
