@@ -11,8 +11,6 @@ from __future__ import annotations
 
 import time
 
-import pytest
-
 from babble_tpu.crypto.keys import generate_key
 from babble_tpu.hashgraph.event import Event, WireBody, WireEvent
 from babble_tpu.net.inmem import InmemNetwork

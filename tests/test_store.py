@@ -165,3 +165,68 @@ def test_inmem_rolling_window_eviction_too_late():
     # recent indexes survive
     assert store.participant_event(p_hex, 24)
     assert store.known_events()[peers.peers[0].id] == 24
+
+
+def test_peer_set_cache_interval_semantics():
+    """PeerSetCache.get returns the entry at the largest recorded round
+    <= the request; repertoire and first-rounds accumulate across sets
+    (reference: caches.go:126-222)."""
+    from babble_tpu.hashgraph.caches import PeerSetCache
+
+    keys = [generate_key() for _ in range(4)]
+    mk = lambda ks: PeerSet(
+        [Peer(f"inmem://c{i}", k.public_key.hex(), f"c{i}")
+         for i, k in enumerate(ks)]
+    )
+    full = mk(keys)
+    smaller = full.with_removed_peer(full.peers[-1])
+
+    cache = PeerSetCache()
+    with pytest.raises(StoreError):
+        cache.get(0)  # empty cache
+    cache.set(0, full)
+    cache.set(5, smaller)
+    with pytest.raises(StoreError) as err:
+        cache.set(5, smaller)  # duplicate round refused
+    assert err.value.kind == StoreErrorKind.KEY_ALREADY_EXISTS
+
+    # interval lookups
+    for r in (0, 1, 4):
+        assert cache.get(r).hash() == full.hash(), f"round {r}"
+    for r in (5, 6, 100):
+        assert cache.get(r).hash() == smaller.hash(), f"round {r}"
+    # below the first recorded round: clamps to the earliest set
+    assert cache.get(-3).hash() == full.hash()
+
+    # repertoire holds every peer ever seen, even after removal
+    assert len(cache.repertoire_by_pub_key) == 4
+    removed = full.peers[-1]
+    assert cache.repertoire_by_id[removed.id].pub_key_hex == removed.pub_key_hex
+    # first_round: the earliest round each peer entered
+    fr, ok = cache.first_round(removed.id)
+    assert ok and fr == 0
+    _, ok2 = cache.first_round(0xDEAD)
+    assert not ok2
+
+
+def test_pending_rounds_cache_ordering():
+    """PendingRoundsCache keeps rounds ordered; update() only MARKS rounds
+    decided (they stay queued for process_decided_rounds, which cleans
+    them afterwards — reference: caches.go:244-297, hashgraph.go:1100+)."""
+    from babble_tpu.hashgraph.caches import PendingRound, PendingRoundsCache
+
+    c = PendingRoundsCache()
+    for r in (5, 2, 9):
+        c.set(PendingRound(r))
+    assert [pr.index for pr in c.get_ordered_pending_rounds()] == [2, 5, 9]
+    assert c.queued(5) and not c.queued(7)
+
+    # update marks decided but keeps rounds queued (they are consumed by
+    # process_decided_rounds, which then cleans them — hashgraph.go:1100+)
+    c.update([2, 5])
+    assert [pr.index for pr in c.get_ordered_pending_rounds()] == [2, 5, 9]
+    assert [pr.decided for pr in c.get_ordered_pending_rounds()] == [
+        True, True, False]
+    c.clean([2, 5])
+    assert [pr.index for pr in c.get_ordered_pending_rounds()] == [9]
+    assert not c.queued(2)
