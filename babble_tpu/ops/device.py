@@ -118,6 +118,28 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
         if _resolved is not None:
             return _resolved
 
+        # Resolution handoff from a parent process (exported below): child
+        # processes of an already-probed parent (bench subprocesses, node
+        # children) must not re-pay the probe — and with a wedged link
+        # they would HANG importing jax before their own probe could run,
+        # because the site hook pins the platform at interpreter start.
+        pre = os.environ.get("BABBLE_DEVICE_RESOLVED")
+        if pre:
+            _resolved = pre
+            if pre == DEAD:
+                return _resolved
+            import jax
+
+            if pre != "default":
+                # pin the actual platform, not just the bookkeeping —
+                # otherwise a child could record "axon" while its backend
+                # quietly initializes to something else, and the
+                # economics switches (on_accelerator) would mis-dispatch
+                os.environ["JAX_PLATFORMS"] = pre
+                jax.config.update("jax_platforms", pre)
+            _setup_compile_cache(jax)
+            return _resolved
+
         target = os.environ.get("JAX_PLATFORMS", "")
         if "jax" in sys.modules:
             # jax already imported (and so already survived backend
@@ -130,11 +152,13 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
         preferred = target.split(",")[0] if target else ""
         if preferred == "cpu" and "jax" in sys.modules:
             # CPU explicitly pinned and the import already survived (test
-            # conftest): nothing to probe.
+            # conftest): nothing to probe. Export the handoff like every
+            # other resolution path so children skip their probe too.
             import jax
 
             _setup_compile_cache(jax)
             _resolved = target
+            os.environ["BABBLE_DEVICE_RESOLVED"] = _resolved
             return _resolved
 
         timed_out = False
@@ -162,6 +186,7 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
                 timeout_s,
             )
             _resolved = DEAD
+            os.environ["BABBLE_DEVICE_RESOLVED"] = DEAD
             return _resolved
         else:
             logger.warning(
@@ -172,6 +197,9 @@ def ensure_device(timeout_s: float = PROBE_TIMEOUT_S) -> str:
             )
             _resolved = "cpu"
             os.environ["JAX_PLATFORMS"] = "cpu"
+
+        # Export for child processes (see the handoff above).
+        os.environ["BABBLE_DEVICE_RESOLVED"] = _resolved
 
         import jax
 

@@ -280,6 +280,12 @@ def bench_dag_pipeline_guarded():
             )
             time.sleep(5.0)
         code = (
+            # inherit the parent's platform resolution (BABBLE_DEVICE_
+            # RESOLVED) BEFORE any jax work: with a wedged tunnel the
+            # child would otherwise hang importing the pinned platform
+            # and burn this attempt's whole deadline
+            "from babble_tpu.ops.device import ensure_device\n"
+            "ensure_device()\n"
             "import bench, json\n"
             f"eps, dt, dev = bench.bench_dag_pipeline(n_events={n_events})\n"
             "print(json.dumps([eps, dt, dev]))\n"

@@ -247,3 +247,24 @@ def test_flock_slots_thread_exclusion(tmp_path):
     s.release()
     s.release()
     s.release()  # over-release is a no-op
+
+
+@pytest.mark.parametrize("seed,n_peers", [(11, 4), (12, 6), (13, 9)])
+def test_accel_matches_oracle_random_streams(seed, n_peers):
+    """Randomized differential: seeded random gossip streams (not just the
+    hand-drawn golden DAGs) through the oracle and the device sweep must
+    produce identical consensus state — fame, round-received, and block
+    bodies. Catches shape/mask bugs the fixed fixtures can't reach
+    (padding buckets, larger peer counts, deeper round structure)."""
+    from babble_tpu.parallel.voting_shard import synthetic_voting_window
+
+    h, _ = synthetic_voting_window(
+        n_peers=n_peers, n_events=120, seed=seed, peer_change=False
+    )
+    ordered = _ordered_events(h)
+    peer_set = h.store.get_peer_set(0)
+    oracle = _replay(ordered, peer_set)
+    accel = _replay(ordered, peer_set, sweep_events=13)
+    assert accel.accel.sweeps > 0
+    assert accel.accel.fallbacks == 0
+    assert _consensus_state(accel) == _consensus_state(oracle)

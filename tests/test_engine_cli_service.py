@@ -188,3 +188,24 @@ def test_cli_config_layering(tmp_path):
     assert conf.cache_size == 777  # from file
     assert conf.sync_limit == 456  # flag beats file
     assert conf.heartbeat_timeout == 0.010  # default survives
+
+
+def test_config_option_forcing():
+    """maintenance-mode implies bootstrap implies store
+    (reference: babble/babble.go:133-143)."""
+    from babble_tpu.config.config import Config
+
+    c = Config(maintenance_mode=True)
+    assert c.bootstrap and c.store
+
+    c2 = Config(bootstrap=True)
+    assert c2.store and not c2.maintenance_mode
+
+    c3 = Config()
+    assert not c3.store and not c3.bootstrap
+
+    # datadir conventions (reference: config/config.go:19-32, 287-308)
+    assert c3.keyfile_path().endswith("priv_key")
+    assert c3.peers_path().endswith("peers.json")
+    assert c3.genesis_peers_path().endswith("peers.genesis.json")
+    assert c3.database_dir.startswith(c3.data_dir)
