@@ -8,7 +8,10 @@ app proxy; a dummy chat-app client process attaches to each. Ports:
   node i:  gossip 127.0.0.1:12000+i   service 127.0.0.1:8000+i
            proxy  127.0.0.1:13000+i   app     127.0.0.1:14000+i
 
-Usage:  python demo/testnet.py [n_nodes] [--signal]
+Usage:  python demo/testnet.py [n_nodes] [--signal] [--accelerator]
+With --accelerator every node runs device consensus sweeps and the whole
+testnet shares one admission-control slot domain (co-located processes
+must not convoy their sweeps on the single device).
 Stop with Ctrl-C (nodes leave politely on SIGTERM).
 """
 
@@ -31,6 +34,7 @@ from babble_tpu.crypto.keys import generate_key  # noqa: E402
 def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 4
     use_signal = "--signal" in sys.argv
+    accelerator = "--accelerator" in sys.argv
     base = tempfile.mkdtemp(prefix="babble_tpu_testnet_")
     print(f"testnet dir: {base}")
 
@@ -76,6 +80,11 @@ def main() -> int:
             ]
             if use_signal:
                 cmd += ["--signal", "--signal-addr", "127.0.0.1:2443"]
+            if accelerator:
+                cmd.append("--accelerator")
+                os.environ.setdefault(
+                    "BABBLE_ACCEL_SLOT_DIR", os.path.join(base, "slots")
+                )
             procs.append(subprocess.Popen(cmd))
             # dummy chat-app client on the other side of the socket pair
             procs.append(
