@@ -17,6 +17,12 @@ class StoreErrorKind(enum.Enum):
     UNKNOWN_PARTICIPANT = "unknown participant"
     EMPTY = "empty"
     KEY_ALREADY_EXISTS = "key already exists"
+    # Write refused because the store is closed (shutdown race). Consensus
+    # objects must be durable before they become visible to gossip, so a
+    # closed store FAILS writes instead of dropping them (the drop let a
+    # node gossip an event, lose it at close, and fork itself after
+    # bootstrap).
+    CLOSED = "store closed"
 
 
 class StoreError(Exception):

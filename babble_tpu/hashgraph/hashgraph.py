@@ -455,6 +455,19 @@ class Hashgraph:
         """Verify signature, check parents, prevent forks, maintain
         coordinates, queue for consensus (reference: hashgraph.go:672-750)."""
         if not event.verify():
+            import os
+
+            if os.environ.get("BABBLE_DEBUG_REJECTS"):
+                logger.error(
+                    "REJECT %s creator=%s idx=%s parents=%r txs=%d itxs=%d "
+                    "sigs=%d ts=%s sig=%s",
+                    event.hex(), event.creator()[:24], event.index(),
+                    [p[:20] for p in event.body.parents],
+                    len(event.body.transactions),
+                    len(event.body.internal_transactions),
+                    len(event.body.block_signatures),
+                    event.body.timestamp, event.signature[:40],
+                )
             raise ValueError(f"invalid event signature {event.hex()}")
 
         self._check_self_parent(event)

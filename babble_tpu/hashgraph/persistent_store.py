@@ -168,14 +168,40 @@ class PersistentStore:
             return _event_from_json(row[0])
 
     def set_event(self, event: Event) -> None:
-        self._inmem.set_event(event)
+        # DB first, memory second: an event must be DURABLE before it can
+        # become visible to gossip. A silently dropped disk write during the
+        # shutdown race let a node gossip an event, lose it at close, then
+        # re-sign a different event at the same index after bootstrap — a
+        # cross-incarnation self-fork that wedges every peer still holding
+        # the first incarnation's event (observed as the recycle tests'
+        # "invalid event signature" livelock). Failing the insert instead
+        # keeps the event out of this node's head chain entirely.
         if self._maintenance:
+            self._inmem.set_event(event)
             return
+        fresh = self._persist_event(event)
+        try:
+            self._inmem.set_event(event)
+        except BaseException:
+            if fresh:
+                # the cache rejected an event the DB just gained (e.g. a
+                # trusted frame-event insert hitting an index gap): roll
+                # the fresh rows back so the next incarnation's bootstrap
+                # never replays an event this one refused. Pre-existing
+                # rows (annotation re-sets) are left untouched.
+                self._unpersist_event(event)
+            raise
+
+    def _persist_event(self, event: Event) -> bool:
+        """Write through to the DB; returns True when the rows are new
+        (vs. a re-set of an already-durable event)."""
         key = event.hex()
         d = {"Body": event.body.to_dict(), "Signature": event.signature}
         with self._db_lock:
             if self._db is None:
-                return  # shutdown race: drop the write like maintenance mode
+                raise StoreError(
+                    "PersistentStore", StoreErrorKind.CLOSED, key
+                )
             cur = self._db.execute("SELECT topo FROM events WHERE key = ?", (key,))
             row = cur.fetchone()
             topo = row[0] if row else self._next_topo
@@ -189,6 +215,20 @@ class PersistentStore:
             self._db.execute(
                 "INSERT OR REPLACE INTO events (key, topo, data) VALUES (?, ?, ?)",
                 (key, topo, canonical_dumps(d).decode()),
+            )
+            self._db.commit()
+            return row is None
+
+    def _unpersist_event(self, event: Event) -> None:
+        key = event.hex()
+        with self._db_lock:
+            if self._db is None:
+                return
+            self._db.execute("DELETE FROM events WHERE key = ?", (key,))
+            self._db.execute(
+                "DELETE FROM participant_events WHERE participant = ? "
+                "AND idx = ? AND hash = ?",
+                (event.creator(), event.index(), key),
             )
             self._db.commit()
 
@@ -355,7 +395,15 @@ class PersistentStore:
             return
         with self._db_lock:
             if self._db is None:
-                return  # shutdown race: drop the write like maintenance mode
+                # Same fail-closed policy as events: a silently dropped
+                # write leaves the durable history behind what this
+                # incarnation advertised to the network. Derived objects
+                # (rounds/blocks/frames) replay from events, but a loud
+                # failure is strictly safer than a silent gap — the dying
+                # caller handles it like any other store error.
+                raise StoreError(
+                    "PersistentStore", StoreErrorKind.CLOSED, sql.split()[2]
+                )
             self._db.execute(sql, args)
             self._db.commit()
 

@@ -352,3 +352,41 @@ def test_bootstrap_replays_membership_change(tmp_path):
     finally:
         for n in nodes2:
             n.shutdown()
+
+
+def test_closed_store_refuses_event_writes(tmp_path):
+    """A closed store FAILS event writes instead of dropping them: events
+    must be durable before they become visible to gossip, or a node can
+    gossip an event, lose it at shutdown, and re-sign a different event at
+    the same index after bootstrap — a cross-incarnation self-fork that
+    permanently wedges peers holding the first incarnation's event."""
+    from babble_tpu.common.errors import StoreError, StoreErrorKind
+
+    key = generate_key()
+    store = PersistentStore(cache_size=100, path=str(tmp_path / "c.db"))
+    peers = make_peers([key])
+    store.set_peer_set(0, peers)
+
+    e0 = Event.new([b"pre"], [], [], ["", ""], key.public_key.bytes(), 0)
+    e0.sign(key)
+    store.set_event(e0)
+    store.close()
+
+    e1 = Event.new([b"post"], [], [], [e0.hex(), ""], key.public_key.bytes(), 1)
+    e1.sign(key)
+    with pytest.raises(StoreError) as err:
+        store.set_event(e1)
+    assert err.value.kind == StoreErrorKind.CLOSED
+    # the refused event is invisible: not even in the in-memory cache, so
+    # it can never become this node's head or be gossiped
+    with pytest.raises(StoreError):
+        store.get_event(e1.hex())
+    assert store.known_events()[peers.peers[0].id] == 0
+
+    # the durable prefix survives for the next incarnation (fresh store:
+    # empty cache, so this read proves the DB row exists)
+    store2 = PersistentStore(cache_size=100, path=str(tmp_path / "c.db"))
+    assert store2.get_event(e0.hex()).body.hash() == e0.body.hash()
+    with pytest.raises(StoreError):
+        store2.get_event(e1.hex())
+    store2.close()
