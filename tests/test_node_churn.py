@@ -285,7 +285,10 @@ def test_churn_with_accelerator():
         check_gossip(live, 0, 1)
         check_peer_sets(live)
 
-        # one node politely leaves mid-pipeline
+        # one node politely leaves mid-pipeline (generous consensus wait:
+        # under full-suite load on one core the PEER_REMOVE can take a
+        # while to commit, and leave() raises TimeoutError past this)
+        nodes[2].conf.join_timeout = 120.0
         nodes[2].leave()
         live = [nodes[0], nodes[1], joiner]
         target = live[0].get_last_block_index() + 3
