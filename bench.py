@@ -167,6 +167,7 @@ def bench_gossip(
 
     max_backlog = 5000
     t_pace0 = time.monotonic()
+    i_pace0 = 0
 
     def pump() -> None:
         nonlocal i
@@ -176,9 +177,11 @@ def bench_gossip(
             # this thread stalls and catches up late, a real client would
             # have been waiting since the schedule slot (avoiding the
             # coordinated-omission under-report).
-            due = int((time.monotonic() - t_pace0) * offered_tx_s)
+            due = i_pace0 + int(
+                (time.monotonic() - t_pace0) * offered_tx_s
+            )
             while i < due:
-                sched = t_pace0 + (i + 1) / offered_tx_s
+                sched = t_pace0 + (i - i_pace0 + 1) / offered_tx_s
                 tx = f"lat {sched} {i} ".encode()
                 proxies[i % n_nodes].submit_tx(tx.ljust(100, b"x"))
                 i += 1
@@ -200,6 +203,10 @@ def bench_gossip(
 
     base = committed()
     t0 = time.monotonic()
+    # re-base the pacing schedule: startup stalls during warmup must not
+    # count as client wait time in the measured window
+    t_pace0 = t0
+    i_pace0 = i
     while committed() - base < target_txs and time.monotonic() < deadline:
         pump()
     elapsed = time.monotonic() - t0

@@ -37,3 +37,9 @@ def keys3():
     from babble_tpu.crypto.keys import PrivateKey
 
     return [PrivateKey(d) for d in (0xA11CE, 0xB0B, 0xCA401)]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process end-to-end scenarios"
+    )
