@@ -73,12 +73,20 @@ class LatencyState:
     def state_change_handler(self, state) -> None:
         self._inner.state_change_handler(state)
 
-    def latency_percentiles(self, since: float):
+    def latency_percentiles(self, since: float, min_submit: float = 0.0):
         """Percentiles over transactions COMMITTED after ``since`` (filtering
         on commit time, not submit time: under a lagging consensus the
         measurement window's commits are of earlier submits, and those are
-        exactly the latencies that must be reported, not dropped)."""
-        lats = sorted(c - s for s, c in self.commit_times if c >= since)
+        exactly the latencies that must be reported, not dropped).
+
+        ``min_submit`` additionally drops samples SUBMITTED before it —
+        used by the paced open-loop mode, whose warmup-era schedule stamps
+        would otherwise leak startup wait into the measured window."""
+        lats = sorted(
+            c - s
+            for s, c in self.commit_times
+            if c >= since and s >= min_submit
+        )
         return (
             _percentile(lats, 0.50),
             _percentile(lats, 0.95),
@@ -213,7 +221,12 @@ def bench_gossip(
 
     measured = committed() - base
     txs_per_s = measured / elapsed
-    p50, p95, n_lat = states[0].latency_percentiles(since=t0)
+    p50, p95, n_lat = states[0].latency_percentiles(
+        since=t0,
+        # paced mode: exclude warmup-era schedule stamps (their wait is
+        # startup cost, not client latency at the offered rate)
+        min_submit=t0 if offered_tx_s is not None else 0.0,
+    )
 
     blocks = min(n.get_last_block_index() for n in nodes)
     out = {

@@ -236,3 +236,99 @@ def test_gossip_over_tcp():
     finally:
         for nd in nodes:
             nd.shutdown()
+
+
+def test_tcp_pooled_connections():
+    """Concurrent RPCs to one target succeed and the connection pool never
+    retains more than max_pool sockets (reference:
+    net_transport_test.go:13 TestNetworkTransport_PooledConn,
+    tcp_transport_test.go:30)."""
+    srv = TCPTransport("127.0.0.1:0")
+    srv.listen()
+    cli = TCPTransport("127.0.0.1:0", max_pool=2)
+    cli.listen()
+    stop = threading.Event()
+    _responder(
+        srv, {"SyncRequest": SyncResponse(from_id=2, events=[], known={})},
+        stop,
+    )
+    try:
+        results = []
+        errs = []
+
+        def one(k):
+            try:
+                got = cli.sync(
+                    srv.advertise_addr(),
+                    SyncRequest(from_id=k, known={}, sync_limit=10),
+                )
+                results.append(got.from_id)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(k,)) for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert not errs, errs
+        assert results == [2] * 8
+        with cli._pool_lock:
+            pooled = sum(len(v) for v in cli._pool.values())
+        assert pooled <= 2, f"pool retained {pooled} > max_pool sockets"
+        # pooled connections are REUSED: sequential calls must check the
+        # SAME socket objects back out, not dial fresh ones
+        with cli._pool_lock:
+            pooled_ids = {id(c) for v in cli._pool.values() for c in v}
+        assert pooled_ids, "nothing pooled to reuse"
+        for k in range(4):
+            cli.sync(srv.advertise_addr(),
+                     SyncRequest(from_id=k, known={}, sync_limit=10))
+        with cli._pool_lock:
+            after_ids = {id(c) for v in cli._pool.values() for c in v}
+        assert after_ids & pooled_ids, (
+            "sequential calls dialed fresh sockets instead of reusing "
+            "the pool"
+        )
+    finally:
+        stop.set()
+        cli.close()
+        srv.close()
+
+
+def test_tcp_bad_addr():
+    """An unbindable address fails loudly at listen (reference:
+    tcp_transport_test.go:13 TestTCPTransport_BadAddr)."""
+    t = TCPTransport("198.51.100.1:0")  # TEST-NET-2: never a local interface
+    with pytest.raises(OSError):
+        t.listen()
+
+
+def test_tcp_with_advertise():
+    """advertise_addr is what peers are told; the bind address still
+    serves (reference: tcp_transport_test.go:20 WithAdvertise)."""
+    srv = TCPTransport("127.0.0.1:0", advertise_addr="node77.example:9000")
+    srv.listen()
+    try:
+        assert srv.advertise_addr() == "node77.example:9000"
+        assert srv.local_addr() != srv.advertise_addr()
+        # the real bound address still answers RPCs
+        stop = threading.Event()
+        _responder(
+            srv,
+            {"SyncRequest": SyncResponse(from_id=9, events=[], known={})},
+            stop,
+        )
+        cli = TCPTransport("127.0.0.1:0")
+        cli.listen()
+        try:
+            got = cli.sync(
+                srv.local_addr(),
+                SyncRequest(from_id=1, known={}, sync_limit=5),
+            )
+            assert got.from_id == 9
+        finally:
+            stop.set()
+            cli.close()
+    finally:
+        srv.close()
