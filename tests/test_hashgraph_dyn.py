@@ -456,3 +456,28 @@ def test_dyn_accel_matches_oracle(script, mode):
     assert accel.sweeps > 0
     assert accel.fallbacks == 0
     assert _consensus_state(dev) == _consensus_state(oracle)
+
+
+@pytest.mark.parametrize("script", list(SCRIPTS))
+def test_dyn_accel_mesh_sharded_matches_oracle(script):
+    """The golden dynamic-membership fixtures through the MESH-SHARDED
+    voting kernel: witness-axis shard_map sweeps with per-round peer-set
+    masks must reproduce the oracle bit for bit across join/leave — the
+    strongest exercise of voting_shard's psi/member machinery (the
+    windows here span up to three peer-set slots)."""
+    from babble_tpu.parallel.mesh import consensus_mesh
+
+    steps, index = SCRIPTS[script]()
+    steps = _preregister(steps)
+    oracle = _build(steps, run_consensus=True)
+    accel = TensorConsensus(
+        sweep_events=3,
+        async_compile=False,
+        min_window=0,
+        pipeline=False,
+        mesh=consensus_mesh(8),
+    )
+    dev = _build(steps, accel=accel, run_consensus=True)
+    assert accel.sweeps > 0
+    assert accel.fallbacks == 0
+    assert _consensus_state(dev) == _consensus_state(oracle)
