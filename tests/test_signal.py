@@ -126,6 +126,52 @@ def test_gossip_three_nodes_over_relay(server):
         shutdown_all(nodes)
 
 
+def test_gossip_over_relay_with_accelerator(server):
+    """Transport x engine matrix cell: device consensus sweeps riding the
+    NAT-symmetric relay transport. Placement of the voting computation
+    must be orthogonal to how gossip moves — blocks stay byte-identical
+    and sweeps engage."""
+    from babble_tpu.hashgraph.accel import TensorConsensus
+
+    keys = [generate_key() for _ in range(2)]
+    peers = PeerSet(
+        [
+            Peer(k.public_key.hex(), k.public_key.hex(), f"ra{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    nodes, proxies = [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.02,
+            slow_heartbeat_timeout=0.2,
+            log_level="warning",
+            moniker=f"ra{i}",
+            accelerator=True,
+        )
+        trans = SignalTransport(server.addr(), k)
+        pr = InmemProxy(DummyState())
+        node = Node(
+            conf, Validator(k, f"ra{i}"), peers, peers,
+            InmemStore(conf.cache_size), trans, pr,
+        )
+        node.init()
+        node.core.hg.accel = TensorConsensus(async_compile=False,
+                                             min_window=0)
+        nodes.append(node)
+        proxies.append(pr)
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=1, timeout=90.0)
+        check_gossip(nodes, 0, 1)
+        for n in nodes:
+            assert n.core.hg.accel.sweeps > 0
+            assert n.core.hg.accel.fallbacks == 0
+    finally:
+        shutdown_all(nodes)
+
+
 def test_unauthenticated_registration_rejected(server):
     """Claiming a pubkey without its private key must not register: the
     server challenges and verifies a signature, so identities cannot be
