@@ -84,14 +84,14 @@ def test_rpc_roundtrip_via_relay(server):
         tb.close()
 
 
-def test_gossip_three_nodes_over_relay(server):
-    """checkGossip oracle over the relay: blocks byte-identical while no
-    node ever accepts an inbound connection."""
-    keys = [generate_key() for _ in range(3)]
-    # in signal mode NetAddr carries the pubkey, not host:port
+def make_relay_cluster(server, n: int, prefix: str = "sig",
+                       accelerator: bool = False):
+    """n nodes gossiping exclusively through the relay (in signal mode
+    NetAddr carries the pubkey, not host:port)."""
+    keys = [generate_key() for _ in range(n)]
     peers = PeerSet(
         [
-            Peer(k.public_key.hex(), k.public_key.hex(), f"sig{i}")
+            Peer(k.public_key.hex(), k.public_key.hex(), f"{prefix}{i}")
             for i, k in enumerate(keys)
         ]
     )
@@ -101,13 +101,14 @@ def test_gossip_three_nodes_over_relay(server):
             heartbeat_timeout=0.02,
             slow_heartbeat_timeout=0.2,
             log_level="warning",
-            moniker=f"sig{i}",
+            moniker=f"{prefix}{i}",
+            accelerator=accelerator,
         )
         trans = SignalTransport(server.addr(), k)
         pr = InmemProxy(DummyState())
         node = Node(
             conf,
-            Validator(k, f"sig{i}"),
+            Validator(k, f"{prefix}{i}"),
             peers,
             peers,
             InmemStore(conf.cache_size),
@@ -117,6 +118,13 @@ def test_gossip_three_nodes_over_relay(server):
         node.init()
         nodes.append(node)
         proxies.append(pr)
+    return nodes, proxies
+
+
+def test_gossip_three_nodes_over_relay(server):
+    """checkGossip oracle over the relay: blocks byte-identical while no
+    node ever accepts an inbound connection."""
+    nodes, proxies = make_relay_cluster(server, 3)
     try:
         for n in nodes:
             n.run_async()
@@ -133,33 +141,11 @@ def test_gossip_over_relay_with_accelerator(server):
     and sweeps engage."""
     from babble_tpu.hashgraph.accel import TensorConsensus
 
-    keys = [generate_key() for _ in range(2)]
-    peers = PeerSet(
-        [
-            Peer(k.public_key.hex(), k.public_key.hex(), f"ra{i}")
-            for i, k in enumerate(keys)
-        ]
-    )
-    nodes, proxies = [], []
-    for i, k in enumerate(keys):
-        conf = Config(
-            heartbeat_timeout=0.02,
-            slow_heartbeat_timeout=0.2,
-            log_level="warning",
-            moniker=f"ra{i}",
-            accelerator=True,
-        )
-        trans = SignalTransport(server.addr(), k)
-        pr = InmemProxy(DummyState())
-        node = Node(
-            conf, Validator(k, f"ra{i}"), peers, peers,
-            InmemStore(conf.cache_size), trans, pr,
-        )
-        node.init()
+    nodes, proxies = make_relay_cluster(server, 2, prefix="ra",
+                                        accelerator=True)
+    for node in nodes:
         node.core.hg.accel = TensorConsensus(async_compile=False,
                                              min_window=0)
-        nodes.append(node)
-        proxies.append(pr)
     try:
         for n in nodes:
             n.run_async()
