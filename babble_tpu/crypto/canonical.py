@@ -26,6 +26,18 @@ class PreNormalized:
         self.value = value
 
 
+def memo_normalized(holder: Any, build) -> Any:
+    """Shared memo for normalized() encoders (wire events, event bodies):
+    compute _normalize(build()) once and cache it on ``holder._norm``.
+    Callers must invalidate by setting ``holder._norm = None`` when the
+    underlying object mutates."""
+    n = getattr(holder, "_norm", None)
+    if n is None:
+        n = _normalize(build())
+        holder._norm = n
+    return n
+
+
 def _normalize(obj: Any) -> Any:
     # exact-type fast path ordered by frequency (leaves dominate): this
     # walk runs for every event hash on the insert hot path. Subclasses

@@ -66,6 +66,19 @@ class EventBody:
     self_parent_index: int = -1
     other_parent_index: int = -1
 
+    def normalized(self) -> dict:
+        """Canonically normalized to_dict (bytes already base64), memoized.
+        Frames re-encode every contained event body per decided round
+        (frame.hash, Block.from_frame); the consensus-visible body is
+        immutable after creation, so each body pays the b64 walk once per
+        process instead of once per frame it appears in."""
+        from babble_tpu.crypto.canonical import memo_normalized
+
+        return memo_normalized(self, self.to_dict)
+
+    def invalidate_normalized(self) -> None:
+        self._norm = None
+
     def to_dict(self) -> dict:
         return {
             "Transactions": list(self.transactions),
@@ -78,8 +91,12 @@ class EventBody:
         }
 
     def hash(self) -> bytes:
-        """SHA256 of the canonical encoding (reference: event.go:57-64)."""
-        return sha256(canonical_dumps(self.to_dict()))
+        """SHA256 of the canonical encoding (reference: event.go:57-64).
+        Shares the normalized memo with the frame/wire encoders, so the
+        b64 walk happens once per body however it is consumed."""
+        from babble_tpu.crypto.canonical import PreNormalized
+
+        return sha256(canonical_dumps(PreNormalized(self.normalized())))
 
     @staticmethod
     def from_dict(d: dict) -> "EventBody":
@@ -267,6 +284,7 @@ class Event:
         self._creator = ""
         self._sig_ok = None
         self._wire = None
+        self.body.invalidate_normalized()
 
     # -- signatures --------------------------------------------------------
 
@@ -431,13 +449,9 @@ class WireEvent:
         Event.to_wire shares one WireEvent per event, so each event's
         transactions are b64-encoded once total rather than once per peer
         it is pushed to."""
-        n = getattr(self, "_norm", None)
-        if n is None:
-            from babble_tpu.crypto.canonical import _normalize
+        from babble_tpu.crypto.canonical import memo_normalized
 
-            n = _normalize(self.to_dict())
-            self._norm = n
-        return n
+        return memo_normalized(self, self.to_dict)
 
     @staticmethod
     def from_dict(d: dict) -> "WireEvent":
@@ -457,9 +471,13 @@ class FrameEvent:
     witness: bool = False
 
     def to_dict(self) -> dict:
+        from babble_tpu.crypto.canonical import PreNormalized
+
         return {
+            # memoized normalized body: frames re-encode the same immutable
+            # event bodies per decided round (see EventBody.normalized)
             "Core": {
-                "Body": self.core.body.to_dict(),
+                "Body": PreNormalized(self.core.body.normalized()),
                 "Signature": self.core.signature,
             },
             "Round": self.round,
@@ -469,8 +487,14 @@ class FrameEvent:
 
     @staticmethod
     def from_dict(d: dict) -> "FrameEvent":
+        from babble_tpu.crypto.canonical import PreNormalized
+
+        body = d["Core"]["Body"]
+        if isinstance(body, PreNormalized):
+            # in-process round trip of a to_dict (no codec in between)
+            body = body.value
         core = Event(
-            EventBody.from_dict(d["Core"]["Body"]),
+            EventBody.from_dict(body),
             signature=d["Core"].get("Signature", ""),
         )
         return FrameEvent(

@@ -196,7 +196,14 @@ class PersistentStore:
         """Write through to the DB; returns True when the rows are new
         (vs. a re-set of an already-durable event)."""
         key = event.hex()
-        d = {"Body": event.body.to_dict(), "Signature": event.signature}
+        from babble_tpu.crypto.canonical import PreNormalized
+
+        # memoized body form: byte-identical stored JSON, reusing the
+        # normalization the insert-path hash already paid for
+        d = {
+            "Body": PreNormalized(event.body.normalized()),
+            "Signature": event.signature,
+        }
         with self._db_lock:
             if self._db is None:
                 raise StoreError(

@@ -780,8 +780,10 @@ def _round_trip_frame(frame: Frame) -> Frame:
 
 
 def _js_bytes(o):
-    from babble_tpu.crypto.canonical import b64
+    from babble_tpu.crypto.canonical import PreNormalized, b64
 
+    if isinstance(o, PreNormalized):
+        return o.value
     if isinstance(o, (bytes, bytearray)):
         return b64(bytes(o))
     raise TypeError(str(type(o)))
