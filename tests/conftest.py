@@ -43,3 +43,32 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process end-to-end scenarios"
     )
+
+
+def setup_testnet_datadirs(tmp_path, n: int, base_port: int,
+                           moniker_prefix: str = "n"):
+    """keygen + peers.json/peers.genesis.json for an n-node localhost
+    testnet — the one datadir scaffolding shared by the engine, example,
+    and crash-recovery suites."""
+    from babble_tpu.crypto.keyfile import SimpleKeyfile
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.peers.json_peer_set import JSONPeerSet
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+
+    keys = [generate_key() for _ in range(n)]
+    peers = PeerSet(
+        [
+            Peer(f"127.0.0.1:{base_port + i}", k.public_key.hex(),
+                 f"{moniker_prefix}{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    datadirs = []
+    for i, k in enumerate(keys):
+        d = tmp_path / f"{moniker_prefix}{i}"
+        d.mkdir()
+        SimpleKeyfile(str(d / "priv_key")).write_key(k)
+        JSONPeerSet(str(d)).write(peers)
+        datadirs.append(d)
+    return keys, peers, datadirs
