@@ -23,22 +23,11 @@ from babble_tpu.peers.peer_set import PeerSet
 
 
 def _setup_datadirs(tmp_path, n: int, base_port: int):
-    """keygen + peers.json for an n-node testnet on localhost."""
-    keys = [generate_key() for _ in range(n)]
-    peers = PeerSet(
-        [
-            Peer(f"127.0.0.1:{base_port + i}", k.public_key.hex(), f"n{i}")
-            for i, k in enumerate(keys)
-        ]
-    )
-    datadirs = []
-    for i, k in enumerate(keys):
-        d = tmp_path / f"node{i}"
-        d.mkdir()
-        SimpleKeyfile(str(d / "priv_key")).write_key(k)
-        JSONPeerSet(str(d)).write(peers)
-        datadirs.append(d)
-    return keys, peers, datadirs
+    """keygen + peers.json for an n-node testnet on localhost (shared
+    scaffolding: conftest.setup_testnet_datadirs)."""
+    from conftest import setup_testnet_datadirs
+
+    return setup_testnet_datadirs(tmp_path, n, base_port)
 
 
 def test_engine_testnet_with_service(tmp_path):
