@@ -526,11 +526,23 @@ class TensorConsensus:
             )
 
     def stats(self) -> dict:
+        from babble_tpu.ops.device import jax_usable
+
+        if jax_usable():
+            from babble_tpu.ops import voting as _voting
+
+            pallas = _voting.pallas_mode()
+        else:
+            pallas = None  # DEAD link: importing voting would import jax
         avg_ms = (
             1000.0 * self.total_sweep_s / self.sweeps if self.sweeps else 0.0
         )
         return {
             "consensus_engine": "device",
+            # which strongly-see path the sweep kernels trace: "tpu" =
+            # Pallas on hardware, "interpret" = Pallas interpreter
+            # (tests), None = XLA einsum
+            "accel_pallas": pallas,
             "accel_sweeps": self.sweeps,
             "accel_fallbacks": self.fallbacks,
             "accel_compile_waits": self.compile_waits,

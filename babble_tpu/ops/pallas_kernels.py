@@ -48,6 +48,73 @@ def _ss_kernel(n_peers: int, super_majority: int, la_t_ref, fd_t_ref,
     out_ref[:] = acc >= super_majority
 
 
+TILE_V = 128
+
+
+def _member_ss_kernel(n_peers: int, la_t_ref, fdm_ref, out_ref):
+    """One [1, TILE_V, W] tile of the per-slot strongly-see counts:
+    counts[s, v, w] = #{p : la[v,p] >= fd_masked[s,w,p]}. The peer-set
+    membership is pre-folded into ``fd_masked`` (non-members carry the
+    INT32_MAX sentinel, so their compare can never pass) — that keeps the
+    kernel free of data-dependent scalar loads; the peer axis is a static
+    unroll of [TILE_V, W] VPU compare+adds, as in _ss_kernel."""
+    acc = jnp.zeros(out_ref.shape[1:], jnp.int32)
+    for p in range(n_peers):
+        la_row = la_t_ref[p, :]  # [TILE_V] this block's voter coordinates
+        fd_row = fdm_ref[0, p, :]  # [W] this slot's masked candidates
+        acc += (la_row[:, None] >= fd_row[None, :]).astype(jnp.int32)
+    out_ref[0, :, :] = acc
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def member_ss_counts_pallas(la, fd, member, interpret: bool = False):
+    """Per-peer-set strongly-see counts for the LIVE voting sweep — the
+    Pallas form of ops/voting.py's dominant [W, W, P] membership einsum:
+
+        counts[s, v, w] = sum_p member[s, p] * (la[v, p] >= fd[w, p])
+
+    without materializing the [W, W, P] compare tensor: each grid step
+    holds one [P, TILE_V] coordinate slice and one [P, W] masked-candidate
+    slab in VMEM. Inputs la/fd are [W, P] (voting window W-space), member
+    is [S, P] bool; returns int32 [S, W, W] (the >= super-majority compare
+    stays outside — it is a cheap XLA elementwise over a small output).
+
+    The membership mask folds into the operands host-side: a non-member
+    peer's first-descendant becomes INT32_MAX, which no last-ancestor can
+    reach — bit-identical to multiplying the compare by member[s, p].
+    """
+    W, P = la.shape
+    S = member.shape[0]
+    P_pad = -P % 8
+    W_pad = -W % TILE_V
+    if P_pad:
+        la = jnp.pad(la, ((0, 0), (0, P_pad)), constant_values=-1)
+        fd = jnp.pad(fd, ((0, 0), (0, P_pad)), constant_values=INT32_MAX)
+        member = jnp.pad(member, ((0, 0), (0, P_pad)), constant_values=False)
+    if W_pad:
+        la = jnp.pad(la, ((0, W_pad), (0, 0)), constant_values=-1)
+        fd = jnp.pad(fd, ((0, W_pad), (0, 0)), constant_values=INT32_MAX)
+    Wp, Pp = la.shape
+    la_t = la.T  # [Pp, Wp]
+    # [S, Pp, Wp]: slot-masked candidates, transposed so the fast axis is W
+    fd_masked = jnp.where(
+        member[:, :, None], fd.T[None, :, :], INT32_MAX
+    )
+    kernel = partial(_member_ss_kernel, Pp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(S, Wp // TILE_V),
+        in_specs=[
+            pl.BlockSpec((Pp, TILE_V), lambda s, i: (0, i)),
+            pl.BlockSpec((1, Pp, Wp), lambda s, i: (s, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_V, Wp), lambda s, i: (s, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, Wp, Wp), jnp.int32),
+        interpret=interpret,
+    )(la_t, fd_masked)
+    return out[:, :W, :W]
+
+
 @partial(jax.jit, static_argnames=("super_majority", "interpret"))
 def strongly_see_pallas(la, fd, super_majority: int, interpret: bool = False):
     """SS[x, y] over [E, P] coordinate tensors, Pallas-tiled.

@@ -140,6 +140,24 @@ class VotingWindow:
 # =============================================================================
 
 
+def pallas_mode() -> Optional[str]:
+    """How the live sweep's membership strongly-see should run:
+    ``"tpu"`` (BABBLE_PALLAS=1 on a real TPU — the Pallas tiled kernel),
+    ``"interpret"`` (BABBLE_PALLAS_INTERPRET=1 — the same kernel in
+    interpreter mode, for differential tests on CPU), or None (the XLA
+    einsum). Evaluated at TRACE time, so it must be set before the first
+    sweep of a shape bucket compiles."""
+    import os
+
+    if os.environ.get("BABBLE_PALLAS_INTERPRET") == "1":
+        return "interpret"
+    if os.environ.get("BABBLE_PALLAS") != "1":
+        return None
+    from babble_tpu.ops.device import on_tpu
+
+    return "tpu" if on_tpu() else None
+
+
 def _fame_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
                wit_idx, member, sm_s, psi, sm_r):
     """Virtual voting on the witness axis (oracle: hashgraph.go:875-998)
@@ -153,17 +171,29 @@ def _fame_core(creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
 
     # SS[s, w, w'] per peer-set slot (oracle: hashgraph.go:172-206 with the
     # per-round peer-set argument). [W, W, P] compare stays small because W
-    # is the witness count, not the event count. Operands are 0/1, so int8
-    # inputs with an int32 accumulator are EXACT while letting the TPU tile
-    # the contraction onto the MXU (int8 matmul units) instead of the VPU;
-    # counts are bounded by P (peer axis) which fits int32 trivially.
-    ge = (la_w[:, None, :] >= fd_w[None, :, :]).astype(jnp.int8)
-    counts = jnp.einsum(
-        "vwp,sp->svw",
-        ge,
-        member.astype(jnp.int8),
-        preferred_element_type=jnp.int32,
-    )
+    # is the witness count, not the event count.
+    mode = pallas_mode()
+    if mode is not None:
+        # Pallas tiled kernel: streams the peer axis through VMEM, no
+        # [W, W, P] intermediate (ops/pallas_kernels.py). Bit-identical
+        # counts; differential-tested in interpreter mode.
+        from babble_tpu.ops.pallas_kernels import member_ss_counts_pallas
+
+        counts = member_ss_counts_pallas(
+            la_w, fd_w, member, interpret=(mode == "interpret")
+        )
+    else:
+        # XLA einsum: operands are 0/1, so int8 inputs with an int32
+        # accumulator are EXACT while letting the TPU tile the contraction
+        # onto the MXU (int8 matmul units) instead of the VPU; counts are
+        # bounded by P (peer axis) which fits int32 trivially.
+        ge = (la_w[:, None, :] >= fd_w[None, :, :]).astype(jnp.int8)
+        counts = jnp.einsum(
+            "vwp,sp->svw",
+            ge,
+            member.astype(jnp.int8),
+            preferred_element_type=jnp.int32,
+        )
     ss_all = counts >= sm_s[:, None, None]  # [S, W, W]
 
     def per_round(j, state):
