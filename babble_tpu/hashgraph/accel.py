@@ -156,7 +156,8 @@ class TensorConsensus:
     def __init__(self, sweep_events: int = 256, async_compile: bool = True,
                  min_window: int | None = None,
                  pipeline: bool | None = None,
-                 mesh=None):
+                 mesh=None,
+                 batcher: bool | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -183,6 +184,12 @@ class TensorConsensus:
         # the device mesh (parallel/voting_shard.py) instead of on one
         # device. Output is bit-identical; only placement differs.
         self.mesh = mesh
+        # Co-located batching: route sweeps through the process-wide
+        # SweepBatcher so all nodes on this host share ONE device dispatch
+        # per flush wave (BASELINE config-3 architecture). None = resolve
+        # from BABBLE_ACCEL_BATCH at first flush. Mutually exclusive with
+        # mesh sharding (the batcher dispatches single-device programs).
+        self.batcher = batcher
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0
@@ -323,9 +330,36 @@ class TensorConsensus:
             # Wedged device link: importing jax would hang the node.
             return False
         if self.pipeline is None:
-            from babble_tpu.ops.device import on_accelerator
+            import os
 
-            self.pipeline = on_accelerator()
+            env = os.environ.get("BABBLE_ACCEL_PIPELINE")
+            if env is not None:
+                # test/bench override: exercise the pipelined (or sync)
+                # path regardless of the resolved backend
+                self.pipeline = env == "1"
+            else:
+                from babble_tpu.ops.device import on_accelerator
+
+                self.pipeline = on_accelerator()
+        if self.batcher is None:
+            import os
+
+            # Default: batch only on a REAL accelerator, where dispatch is
+            # async and a vmapped batch costs ~one window's latency. On
+            # host XLA a central dispatcher convoys sweeps that already
+            # run at full host throughput (measured: 16-node threaded
+            # accel dropped ~2.7x with the batcher forced on), so CPU
+            # tests that force pipeline=True must not pick it up.
+            # BABBLE_ACCEL_BATCH=1/0 overrides either way; mesh-sharded
+            # dispatch and the batcher are mutually exclusive (the batcher
+            # stacks single-device programs).
+            env = os.environ.get("BABBLE_ACCEL_BATCH")
+            if env is not None:
+                self.batcher = env == "1" and self.mesh is None
+            else:
+                from babble_tpu.ops.device import on_accelerator
+
+                self.batcher = on_accelerator() and self.mesh is None
         if not self.pipeline:
             if not self.use_device(len(hg.undetermined_events)):
                 return False
@@ -409,6 +443,36 @@ class TensorConsensus:
             self._note_fallback(err)
             return False
         self.stage_s["build"] += time.perf_counter() - t0
+
+        if self.batcher:
+            # Co-located batching: the process-wide batcher coalesces this
+            # window with other nodes' into ONE device dispatch + readback;
+            # its own backpressure replaces the admission slots.
+            from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+            ticket = SweepBatcher.instance().submit(win)
+            if ticket is None:
+                # backlogged: the oracle carries this flush (same
+                # economics as losing an admission slot)
+                self.contended += 1
+                return False
+            inf = _Inflight(win, self.generation, hg.topological_index, None)
+
+            def batch_reader() -> None:
+                try:
+                    ticket.done.wait()
+                    if ticket.error is not None:
+                        inf.error = ticket.error
+                    else:
+                        inf.result = ticket.result
+                finally:
+                    inf.t_done = time.perf_counter()
+                    inf.done.set()
+
+            threading.Thread(target=batch_reader, daemon=True).start()
+            self._inflight = inf
+            self._last_snapshot_topo = hg.topological_index
+            return True
 
         # Admission control covers only actual device occupancy — the
         # host-side window build above runs slot-free so co-located nodes
@@ -496,7 +560,25 @@ class TensorConsensus:
                 return False
             t1 = time.perf_counter()
             self.stage_s["build"] += t1 - t0
-            fame, rr = voting.read_sweep(self._dispatch(win), win)
+            if self.batcher:
+                # Synchronous mode still coalesces with concurrent nodes:
+                # submit and wait — co-located threads flushing in the
+                # same wave share the dispatch.
+                from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+                ticket = SweepBatcher.instance().submit(win)
+                if ticket is None:
+                    self.contended += 1
+                    return False
+                if not ticket.done.wait(self.readback_timeout_s):
+                    raise TimeoutError(
+                        f"batched sweep exceeded {self.readback_timeout_s:.0f}s"
+                    )
+                if ticket.error is not None:
+                    raise ticket.error
+                fame, rr = ticket.result
+            else:
+                fame, rr = voting.read_sweep(self._dispatch(win), win)
             t2 = time.perf_counter()
             self.stage_s["kernel"] += t2 - t1
             voting.apply_fame(hg, win, fame)
@@ -537,12 +619,13 @@ class TensorConsensus:
         avg_ms = (
             1000.0 * self.total_sweep_s / self.sweeps if self.sweeps else 0.0
         )
-        return {
+        out = {
             "consensus_engine": "device",
             # which strongly-see path the sweep kernels trace: "tpu" =
             # Pallas on hardware, "interpret" = Pallas interpreter
             # (tests), None = XLA einsum
             "accel_pallas": pallas,
+            "accel_batcher": bool(self.batcher),
             "accel_sweeps": self.sweeps,
             "accel_fallbacks": self.fallbacks,
             "accel_compile_waits": self.compile_waits,
@@ -563,6 +646,26 @@ class TensorConsensus:
                 k: round(1000.0 * v, 1) for k, v in self.stage_s.items()
             },
         }
+        if self.batcher:
+            from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+            out.update(SweepBatcher.instance().stats())
+        return out
+
+
+def batcher_default_on() -> bool:
+    """Whether TensorConsensus will resolve batcher=True with default
+    settings: forced by BABBLE_ACCEL_BATCH, else pipelined (accelerator)
+    mode. Used by prewarm to decide whether the batched floor bucket is
+    worth compiling."""
+    import os
+
+    env = os.environ.get("BABBLE_ACCEL_BATCH")
+    if env is not None:
+        return env == "1"
+    from babble_tpu.ops.device import jax_usable, on_accelerator
+
+    return jax_usable() and on_accelerator()
 
 
 def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
@@ -598,6 +701,29 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
         ]
 
     def work() -> None:
+        if mesh is None and batcher_default_on():
+            # Seed the co-located batcher: compile the B=MAX_BATCH floor
+            # bucket and pin it as the batcher's target floor, so the
+            # FIRST flush wave meets a warm batched program instead of a
+            # compile kick (the monotone target then stays inside this
+            # shape until windows genuinely outgrow it).
+            from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+            floor = (
+                (128, 1024, P, 1, 32) if n_peers >= 12 else (64, 512, P, 1, 16)
+            )
+            svc = SweepBatcher.instance()
+            if svc.floor_key is None or tuple(
+                max(a, b) for a, b in zip(svc.floor_key, floor)
+            ) != svc.floor_key:
+                try:
+                    voting.precompile_batched(SweepBatcher.MAX_BATCH, *floor)
+                    svc.floor_key = floor
+                except Exception:
+                    logger.warning(
+                        "batched floor prewarm failed for %s", floor,
+                        exc_info=True,
+                    )
         for key in buckets:
             mesh_covers = (
                 mesh is not None and key[0] % mesh.devices.size == 0

@@ -1,0 +1,250 @@
+"""SweepBatcher — ONE device dispatch for all co-located nodes' sweeps.
+
+Multi-validator hosts (the 16-node threaded topology, tests, any
+in-process cluster) run many consensus engines against ONE device. The
+per-node admission control in :mod:`babble_tpu.hashgraph.accel` keeps
+their sweeps from convoying, but it is still one dispatch+readback PER
+NODE — n nodes pay n tunnel readbacks per flush cycle, and the losers
+ride the host oracle.
+
+The batcher replaces that with data parallelism over the node axis: flush
+requests arriving within a short coalesce window are grouped by window
+shape bucket, stacked along a leading batch axis, and dispatched as ONE
+vmapped program (``ops.voting._batched_sweep_jit``) with ONE readback for
+the whole host. This is the architecture BASELINE.md's config 3 calls
+for — one chip batching consensus for many co-located validators — and
+it is the tpu-native answer to the reference's per-process nodes (each Go
+node owns its consensus loop, node.go; here the device amortizes them).
+
+Semantics: vmap adds a batch dimension and never mixes rows, so each
+window's [fame | round_received] vector is bit-identical to its
+single-dispatch result (pinned by tests/test_sweep_batcher.py). Failures
+set the ticket error and the owning node falls back to its oracle —
+exactly the degradation contract of TensorConsensus.
+
+Enabled per-node via ``BABBLE_ACCEL_BATCH=1`` (TensorConsensus resolves
+it at first flush). The batcher is in-process by design: cross-process
+coalescing would need shared device buffers; separate processes keep the
+flock admission slots instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("babble_tpu.hashgraph.sweep_batcher")
+
+
+class Ticket:
+    """One node's submitted window; the batcher delivers (fame, rr) or an
+    error. ``done`` is set exactly once."""
+
+    __slots__ = ("win", "result", "error", "done", "batch_size")
+
+    def __init__(self, win):
+        self.win = win
+        self.result = None  # (fame, rr) numpy arrays
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.batch_size = 0  # how many windows shared the dispatch
+
+
+class SweepBatcher:
+    """Process-wide coalescing dispatcher (one daemon thread)."""
+
+    _instance: Optional["SweepBatcher"] = None
+    _instance_lock = threading.Lock()
+
+    #: how long the dispatcher waits after the first submission for
+    #: co-located nodes' flushes to land. Gossip heartbeats are >= 10 ms,
+    #: so a few ms captures one whole flush wave without adding visible
+    #: decision latency (the pipelined mode hides it behind gossip anyway).
+    COALESCE_S = 0.004
+    MAX_BATCH = 16
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: List[Ticket] = []
+        self._work = threading.Event()
+        self._compiling: set = set()
+        # Shape-space discipline: every batched dispatch pads to B =
+        # MAX_BATCH and to a MONOTONE target bucket (elementwise max of
+        # everything seen, seeded by the prewarmed ``floor_key``) — without
+        # this, drifting per-wave buckets spray one-off (B, shape) compiles
+        # and batches never meet a warm program (measured: 9 distinct
+        # compile kicks in one 20 s run, zero warm batches).
+        self.floor_key: Optional[tuple] = None
+        self._target: Optional[tuple] = None
+        # stats
+        self.batches = 0  # batched dispatches (>= 2 windows)
+        self.singles = 0  # lone or unwarmed windows dispatched singly
+        self.windows = 0  # total windows served
+        self.max_batch_seen = 0
+        self.compile_kicks = 0
+        self.refused = 0  # submissions bounced by backpressure
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="sweep-batcher"
+        )
+        self._thread.start()
+
+    @classmethod
+    def instance(cls) -> "SweepBatcher":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    #: refuse submissions past this backlog: the caller's oracle is cheaper
+    #: than queueing behind a convoy (the admission-slot economics, kept).
+    MAX_QUEUE = 32
+
+    def submit(self, win) -> Optional[Ticket]:
+        """Queue a window for the next coalesced dispatch, or return None
+        when the batcher is backlogged — the caller must run its oracle,
+        exactly like losing an admission slot."""
+        with self._lock:
+            if len(self._pending) >= self.MAX_QUEUE:
+                self.refused += 1
+                return None
+            t = Ticket(win)
+            self._pending.append(t)
+        self._work.set()
+        return t
+
+    def stats(self) -> dict:
+        return {
+            "batch_batches": self.batches,
+            "batch_singles": self.singles,
+            "batch_windows": self.windows,
+            "batch_max": self.max_batch_seen,
+            "batch_compile_kicks": self.compile_kicks,
+            "batch_refused": self.refused,
+        }
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            self._work.wait()
+            # Let the rest of the flush wave land before grouping: nodes
+            # flush on the same gossip cadence, so the first submission
+            # predicts more within a few ms.
+            time.sleep(self.COALESCE_S)
+            with self._lock:
+                batch, self._pending = self._pending, []
+                self._work.clear()
+            if batch:
+                try:
+                    self._dispatch(batch)
+                except BaseException as err:  # never kill the daemon
+                    for t in batch:
+                        if not t.done.is_set():
+                            t.error = err
+                            t.done.set()
+                    logger.warning("sweep batch dispatch failed",
+                                   exc_info=True)
+
+    def _dispatch(self, tickets: List[Ticket]) -> None:
+        group = tickets
+        while len(group) > self.MAX_BATCH:
+            head, group = group[: self.MAX_BATCH], group[self.MAX_BATCH:]
+            self._dispatch_group(head)
+        self._dispatch_group(group)
+
+    def _dispatch_group(self, group: List[Ticket]) -> None:
+        from babble_tpu.ops import voting
+
+        # Co-located nodes at slightly different DAG progress land in
+        # DIFFERENT shape buckets; grouping by exact bucket would leave
+        # every wave as singles. Instead the whole wave re-pads to the
+        # monotone target bucket (repad_window: same neutral fills as the
+        # builder, bit-identical decisions) and rides one dispatch.
+        keys = [voting.bucket_key(t.win) for t in group]
+        if self.floor_key is not None:
+            keys.append(self.floor_key)
+        if self._target is not None:
+            keys.append(self._target)
+        target = tuple(max(k[d] for k in keys) for d in range(5))
+        self._target = target
+        B = self.MAX_BATCH
+        if len(group) > 1 and voting.batched_ready(target, B):
+            padded = [voting.repad_window(t.win, target) for t in group]
+            try:
+                out = voting.launch_batched(padded, B)
+                results = voting.read_batched(out, padded)
+            except BaseException as err:
+                for t in group:
+                    t.error = err
+                    t.done.set()
+                return
+            self.batches += 1
+            self.windows += len(group)
+            self.max_batch_seen = max(self.max_batch_seen, len(group))
+            for t, (fame, rr) in zip(group, results):
+                # slice the padded vectors back to the ORIGINAL window's
+                # row spaces (real rows keep their indexes under repad)
+                t.batch_size = len(group)
+                t.result = (
+                    fame[: t.win.n_witnesses],
+                    rr[: t.win.n_events],
+                )
+                t.done.set()
+            return
+        if len(group) > 1:
+            self._kick_compile(target, B)
+        # Unwarmed batch shape (or a lone window): serve through the warm
+        # single-window program so decisions keep flowing. Launch ALL
+        # buffers first, read back after — launch_sweep returns unread
+        # device buffers, so the device overlaps the windows' work and the
+        # wave pays ~one readback latency instead of a serial convoy.
+        launched = []
+        for t in group:
+            try:
+                launched.append((t, voting.launch_sweep(t.win)))
+            except BaseException as err:
+                t.error = err
+                self.singles += 1
+                self.windows += 1
+                t.done.set()
+        for t, out in launched:
+            try:
+                t.result = voting.read_sweep(out, t.win)
+                t.batch_size = 1
+            except BaseException as err:
+                t.error = err
+            self.singles += 1
+            self.windows += 1
+            t.done.set()
+
+    def _kick_compile(self, key: tuple, batch: int) -> None:
+        gate = (batch, key)
+        with self._lock:
+            if gate in self._compiling:
+                return
+            self._compiling.add(gate)
+        self.compile_kicks += 1
+
+        def work() -> None:
+            from babble_tpu.ops import voting
+
+            try:
+                t0 = time.perf_counter()
+                voting.precompile_batched(batch, *key)
+                logger.info(
+                    "batched sweep ready for B=%d bucket %s in %.1fs",
+                    batch, key, time.perf_counter() - t0,
+                )
+            except Exception:
+                logger.warning(
+                    "batched precompile failed for B=%d %s", batch, key,
+                    exc_info=True,
+                )
+            finally:
+                with self._lock:
+                    self._compiling.discard(gate)
+
+        threading.Thread(target=work, daemon=True,
+                         name="sweep-batch-compile").start()

@@ -308,6 +308,12 @@ def _counting_sweep(*args):
 
 _sweep_jit = jax.jit(_counting_sweep)
 
+# Batched sweep: the SAME fused program vmapped over a leading batch axis,
+# so co-located nodes' windows ride ONE device dispatch and ONE readback
+# (hashgraph/sweep_batcher.py). Exact per-window semantics: vmap adds a
+# batch dimension, it never mixes rows.
+_batched_sweep_jit = jax.jit(jax.vmap(_counting_sweep))
+
 
 # =============================================================================
 # Host side: window construction and result application
@@ -509,6 +515,69 @@ def bucket_key(win: VotingWindow) -> tuple:
     )
 
 
+def repad_window(win: VotingWindow, key: tuple) -> VotingWindow:
+    """Grow a window to a LARGER shape bucket with the same neutral fills
+    build_voting_window pads with — co-located nodes at slightly different
+    DAG progress land in different buckets, and the batcher re-pads a
+    whole wave to their elementwise-max bucket so it rides one dispatch.
+
+    Safe by the same argument as the builder's own padding: invalid W rows
+    (valid_w False) never vote and never count; sentinel E rows (index -1,
+    undet False) are seen by nobody and can't receive; extra R rows have no
+    voters (no witness carries their round) and, being past every real
+    round, their hard-block can't cut an earlier receive scan; extra S
+    slots are unreferenced (psi points only at real slots). Row indexes of
+    real entries are preserved, so the result maps back through the
+    ORIGINAL window's row/wit_row tables."""
+    W, E, P, S, R = key
+    W0, E0 = win.n_witnesses, win.n_events
+    P0, S0, R0 = win.member.shape[1], win.member.shape[0], win.psi.shape[0]
+    if (W0, E0, P0, S0, R0) == key:
+        return win
+
+    def pad(a, n, fill):
+        if n == 0:
+            return a
+        widths = [(0, n)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    la_w = pad(win.la_w, W - W0, -1)
+    fd_w = pad(win.fd_w, W - W0, INT32_MAX)
+    if P > P0:
+        la_w = np.pad(la_w, ((0, 0), (0, P - P0)), constant_values=-1)
+        fd_w = np.pad(fd_w, ((0, 0), (0, P - P0)),
+                      constant_values=INT32_MAX)
+    member = pad(win.member, S - S0, False)
+    if P > P0:
+        member = np.pad(member, ((0, 0), (0, P - P0)),
+                        constant_values=False)
+    return VotingWindow(
+        creator=pad(win.creator, E - E0, 0),
+        index=pad(win.index, E - E0, -1),
+        rounds=pad(win.rounds, E - E0, -10),
+        undet=pad(win.undet, E - E0, False),
+        wit_idx=pad(win.wit_idx, W - W0, 0),
+        la_w=la_w,
+        fd_w=fd_w,
+        rounds_w=pad(win.rounds_w, W - W0, -10),
+        valid_w=pad(win.valid_w, W - W0, False),
+        fame0_w=pad(win.fame0_w, W - W0, 0),
+        mid_w=pad(win.mid_w, W - W0, False),
+        member=member,
+        sm_s=pad(win.sm_s, S - S0, 2**30),
+        psi=pad(win.psi, R - R0, 0),
+        sm_r=pad(win.sm_r, R - R0, 2**30),
+        exists_r=pad(win.exists_r, R - R0, False),
+        prior_dec_r=pad(win.prior_dec_r, R - R0, False),
+        lb_gate_r=pad(win.lb_gate_r, R - R0, False),
+        base=win.base,
+        hashes=win.hashes,
+        row=win.row,
+        wit_hashes=win.wit_hashes,
+        wit_row=win.wit_row,
+    )
+
+
 # Compiled-bucket bookkeeping shared by every TensorConsensus in the process
 # (the underlying jit cache is global, so warm-up work must be too).
 _ready_buckets: set = set()
@@ -532,6 +601,28 @@ def bucket_ready(key: tuple) -> bool:
 def mark_bucket_ready(key: tuple) -> None:
     with _bucket_lock():
         _ready_buckets.add(key)
+
+
+# The vmapped program is a different executable per (batch, bucket); its
+# readiness is tracked separately so the batcher can route unwarmed batch
+# shapes through warm single-window dispatches meanwhile.
+_ready_batched: set = set()
+
+
+def batched_ready(key: tuple, batch: int) -> bool:
+    with _bucket_lock():
+        return (batch, key) in _ready_batched
+
+
+def precompile_batched(batch: int, W: int, E: int, P: int, S: int,
+                       R: int) -> None:
+    """Compile (or load from the persistent cache) the batched sweep for a
+    (batch, bucket) pair on all-invalid dummy windows."""
+    key = (W, E, P, S, R)
+    wins = [dummy_window(*key) for _ in range(batch)]
+    read_batched(launch_batched(wins, batch), wins)
+    with _bucket_lock():
+        _ready_batched.add((batch, key))
 
 
 def dummy_window(W: int, E: int, P: int, S: int, R: int) -> VotingWindow:
@@ -568,31 +659,58 @@ def precompile(W: int, E: int, P: int, S: int, R: int) -> None:
     mark_bucket_ready((W, E, P, S, R))
 
 
+# VotingWindow attribute names in _sweep_core's positional order (rounds /
+# undet are the E-space rounds_e / undet_e arguments).
+_WIN_FIELDS = (
+    "creator", "index", "la_w", "fd_w", "rounds_w", "valid_w", "fame0_w",
+    "mid_w", "wit_idx", "member", "sm_s", "psi", "sm_r", "rounds", "undet",
+    "exists_r", "prior_dec_r", "lb_gate_r",
+)
+
+
 def launch_sweep(win: VotingWindow):
     """Dispatch the fused sweep. Returns the device output buffer WITHOUT
     reading it back — dispatch is sub-millisecond; the ~65-100 ms tunnel
     readback is paid by read_sweep (on a background thread in the node's
     pipelined mode)."""
-    return _sweep_jit(
-        jnp.asarray(win.creator),
-        jnp.asarray(win.index),
-        jnp.asarray(win.la_w),
-        jnp.asarray(win.fd_w),
-        jnp.asarray(win.rounds_w),
-        jnp.asarray(win.valid_w),
-        jnp.asarray(win.fame0_w),
-        jnp.asarray(win.mid_w),
-        jnp.asarray(win.wit_idx),
-        jnp.asarray(win.member),
-        jnp.asarray(win.sm_s),
-        jnp.asarray(win.psi),
-        jnp.asarray(win.sm_r),
-        jnp.asarray(win.rounds),
-        jnp.asarray(win.undet),
-        jnp.asarray(win.exists_r),
-        jnp.asarray(win.prior_dec_r),
-        jnp.asarray(win.lb_gate_r),
+    return _sweep_jit(*(jnp.asarray(getattr(win, f)) for f in _WIN_FIELDS))
+
+
+_dummy_cache: Dict[tuple, VotingWindow] = {}
+
+
+def _cached_dummy(key: tuple) -> VotingWindow:
+    """Batch-padding dummies are deterministic per bucket; caching one per
+    key keeps the ~20-array allocation off the hot flush path (the same
+    object is stacked repeatedly — stacking copies the data anyway)."""
+    win = _dummy_cache.get(key)
+    if win is None:
+        win = _dummy_cache[key] = dummy_window(*key)
+    return win
+
+
+def launch_batched(wins: List[VotingWindow], batch: int):
+    """Dispatch ONE batched sweep over same-bucket windows, padded with
+    all-invalid dummies to ``batch`` rows (one program per (B, bucket)).
+    Returns the [B, W+E] device buffer unread."""
+    key = bucket_key(wins[0])
+    ws = list(wins) + [_cached_dummy(key)] * (batch - len(wins))
+    stacked = (
+        jnp.asarray(np.stack([np.asarray(getattr(w, f)) for w in ws]))
+        for f in _WIN_FIELDS
     )
+    return _batched_sweep_jit(*stacked)
+
+
+def read_batched(out, wins: List[VotingWindow]):
+    """ONE readback of the [B, W+E] batched output, split into per-window
+    (fame, rr) pairs (padding rows discarded)."""
+    host = np.asarray(out)
+    res = []
+    for i, w in enumerate(wins):
+        W = w.n_witnesses
+        res.append((host[i, :W], host[i, W:W + w.n_events]))
+    return res
 
 
 def read_sweep(out, win: VotingWindow):

@@ -819,6 +819,12 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
     Returns (txs_per_s, accel_stats_of_busiest_node_or_None)."""
     if accelerator:
         os.environ["BABBLE_PREWARM_BLOCK"] = "1"
+    # Co-located batching engages by default on real-accelerator captures
+    # (TensorConsensus resolves batcher=pipelined): 16 validators on one
+    # host then share ONE device dispatch per flush wave
+    # (hashgraph/sweep_batcher.py) — the BASELINE config-3 architecture.
+    # On CPU-XLA fallback captures sync sweeps stay un-batched (measured
+    # 2.7x regression when a central dispatcher convoys sync sweeps).
     nodes, proxies, states = _make_tcp_cluster(
         16, 28700 if accelerator else 28100, heartbeat=0.05,
         accelerator=accelerator,
@@ -846,6 +852,8 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
                         "accel_sweeps", "accel_avg_sweep_ms",
                         "accel_last_window_events", "accel_compile_waits",
                         "accel_small_windows", "accel_contended",
+                        "accel_batcher", "batch_batches", "batch_windows",
+                        "batch_singles", "batch_max", "batch_refused",
                     )
                 },
                 "accel_contended_total": sum(
@@ -853,6 +861,12 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
                 ),
                 "device": describe(),
             }
+            if any(s.get("accel_batcher") for s in all_stats):
+                from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+                # service-level totals (per-node rows are point-in-time
+                # snapshots of the shared singleton)
+                stats["batcher_service"] = SweepBatcher.instance().stats()
         return rate, stats
     finally:
         for n in nodes:

@@ -1,0 +1,199 @@
+"""SweepBatcher: co-located nodes' sweeps coalesced into ONE vmapped
+device dispatch (babble_tpu/hashgraph/sweep_batcher.py).
+
+Pinned properties:
+- the batched (vmapped) sweep is bit-identical per window to the
+  single-window program, including batch padding rows;
+- concurrent same-bucket submissions actually share a dispatch
+  (ticket.batch_size > 1) once the batched bucket is warm;
+- unwarmed batch shapes degrade to warm single dispatches (liveness);
+- a live accelerated replay with the batcher enabled produces the
+  oracle's exact consensus.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from babble_tpu.ops import voting
+
+
+def _two_windows():
+    """Two same-bucket voting windows from different replayed DAGs."""
+    from tests.test_accel import BUILDERS, _ordered_events
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+
+    wins = []
+    for name in ("consensus", "consensus"):
+        h0, index, nodes, peer_set = BUILDERS[name]()
+        ordered = _ordered_events(h0)
+        h = Hashgraph(InmemStore(1000))
+        h.init(peer_set)
+        # second replay drops the tail event so the windows differ
+        drop = 1 if wins else 0
+        for ev in ordered[: len(ordered) - drop]:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            h.insert_event(e, set_wire_info=True)
+            h.divide_rounds()
+        wins.append(voting.build_voting_window(h))
+    assert wins[0] is not None and wins[1] is not None
+    return wins
+
+
+def test_batched_sweep_matches_single():
+    wins = _two_windows()
+    key0, key1 = voting.bucket_key(wins[0]), voting.bucket_key(wins[1])
+    assert key0 == key1, "builder DAGs should share a shape bucket"
+    singles = [voting.run_sweep(w) for w in wins]
+    for B in (2, 4):
+        batched = voting.read_batched(voting.launch_batched(wins, B), wins)
+        for (f1, r1), (f2, r2) in zip(singles, batched):
+            np.testing.assert_array_equal(f1, f2)
+            np.testing.assert_array_equal(r1, r2)
+
+
+def test_repad_window_preserves_decisions():
+    """A window grown to a larger bucket (every axis) sweeps to the exact
+    decisions of the original — the invariant the batcher's wave re-padding
+    rests on."""
+    wins = _two_windows()
+    for win in wins:
+        W, E, P, S, R = voting.bucket_key(win)
+        grown = voting.repad_window(win, (W * 2, E * 2, P + 8, S * 2, R * 2))
+        f1, r1 = voting.run_sweep(win)
+        f2, r2 = voting.run_sweep(grown)
+        np.testing.assert_array_equal(f1, f2[: len(f1)])
+        np.testing.assert_array_equal(r1, r2[: len(r1)])
+
+
+def test_batcher_coalesces_mixed_buckets():
+    """Windows from DIFFERENT shape buckets still share one dispatch: the
+    wave re-pads to its elementwise-max bucket."""
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    wins = _two_windows()
+    key = voting.bucket_key(wins[0])
+    # grow one window's bucket so the two differ
+    big = voting.repad_window(wins[1], (key[0] * 2, key[1], key[2],
+                                        key[3], key[4]))
+    target = (key[0] * 2,) + key[1:]
+    voting.precompile_batched(SweepBatcher.MAX_BATCH, *target)
+
+    svc = SweepBatcher()
+    singles = [voting.run_sweep(wins[0]), voting.run_sweep(big)]
+    t1, t2 = svc.submit(wins[0]), svc.submit(big)
+    assert t1.done.wait(60) and t2.done.wait(60)
+    assert t1.error is None and t2.error is None, (t1.error, t2.error)
+    assert t1.batch_size == 2 and t2.batch_size == 2
+    for t, (f_want, r_want) in zip((t1, t2), singles):
+        f_got, r_got = t.result
+        np.testing.assert_array_equal(f_got, f_want[: len(f_got)])
+        np.testing.assert_array_equal(r_got, r_want[: len(r_got)])
+
+
+def test_batcher_backpressure_refuses_past_cap():
+    from babble_tpu.hashgraph import sweep_batcher as sb
+
+    win = _two_windows()[0]
+    svc = sb.SweepBatcher.__new__(sb.SweepBatcher)  # no dispatcher thread
+    svc._lock = __import__("threading").Lock()
+    svc._pending = []
+    svc._work = __import__("threading").Event()
+    svc.refused = 0
+    tickets = [svc.submit(win) for _ in range(sb.SweepBatcher.MAX_QUEUE + 3)]
+    assert sum(1 for t in tickets if t is None) == 3
+    assert svc.refused == 3
+
+
+def test_batcher_coalesces_concurrent_submissions():
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    wins = _two_windows()
+    key = voting.bucket_key(wins[0])
+    voting.precompile_batched(SweepBatcher.MAX_BATCH, *key)
+    assert voting.batched_ready(key, SweepBatcher.MAX_BATCH)
+
+    # fresh instance: the singleton's monotone target may have been grown
+    # past this bucket by other tests
+    svc = SweepBatcher()
+    singles = [voting.run_sweep(w) for w in wins]
+    tickets = []
+    lock = threading.Lock()
+
+    def submit(w):
+        t = svc.submit(w)
+        with lock:
+            tickets.append(t)
+        t.done.wait(60)
+
+    threads = [threading.Thread(target=submit, args=(w,)) for w in wins]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert len(tickets) == 2
+    for t in tickets:
+        assert t.done.is_set()
+        assert t.error is None, t.error
+    # both rode one dispatch
+    assert all(t.batch_size == 2 for t in tickets), [
+        t.batch_size for t in tickets
+    ]
+    got = {id(t.win): t.result for t in tickets}
+    for w, (f_want, r_want) in zip(wins, singles):
+        f_got, r_got = got[id(w)]
+        np.testing.assert_array_equal(f_got, f_want)
+        np.testing.assert_array_equal(r_got, r_want)
+
+
+def test_batcher_unwarmed_degrades_to_singles():
+    from babble_tpu.hashgraph import sweep_batcher as sb
+
+    wins = _two_windows()
+    key = voting.bucket_key(wins[0])
+
+    # a fresh service instance (not the singleton) with an un-warmed
+    # batched bucket for the standard batch size: group must ride singles
+    svc = sb.SweepBatcher()
+    with voting._bucket_lock():
+        voting._ready_batched.discard((sb.SweepBatcher.MAX_BATCH, key))
+    t1, t2 = svc.submit(wins[0]), svc.submit(wins[1])
+    assert t1.done.wait(60) and t2.done.wait(60)
+    assert t1.error is None and t2.error is None
+    assert t1.batch_size == 1 and t2.batch_size == 1
+    assert svc.singles >= 2
+    # and the compile kick was recorded so a later wave can batch
+    assert svc.compile_kicks >= 1
+
+
+@pytest.mark.parametrize("graph", ["consensus", "funky_full"])
+def test_accel_with_batcher_matches_oracle(graph):
+    from tests.test_accel import (
+        BUILDERS,
+        _consensus_state,
+        _ordered_events,
+        _replay,
+    )
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+    from babble_tpu.hashgraph.accel import TensorConsensus
+
+    h0, index, nodes, peer_set = BUILDERS[graph]()
+    ordered = _ordered_events(h0)
+    oracle = _replay(ordered, peer_set)
+
+    h = Hashgraph(InmemStore(1000))
+    h.init(peer_set)
+    h.accel = TensorConsensus(sweep_events=8, async_compile=False,
+                              min_window=0, batcher=True)
+    for ev in ordered:
+        e = Event(ev.body, ev.signature)
+        h.insert_event_and_run_consensus(e, set_wire_info=True)
+    h.flush_consensus()
+    assert h.accel.fallbacks == 0
+    assert _consensus_state(h) == _consensus_state(oracle)

@@ -23,25 +23,8 @@ from babble_tpu.ops import voting
 def _sweep_args(win):
     import jax.numpy as jnp
 
-    return (
-        jnp.asarray(win.creator),
-        jnp.asarray(win.index),
-        jnp.asarray(win.la_w),
-        jnp.asarray(win.fd_w),
-        jnp.asarray(win.rounds_w),
-        jnp.asarray(win.valid_w),
-        jnp.asarray(win.fame0_w),
-        jnp.asarray(win.mid_w),
-        jnp.asarray(win.wit_idx),
-        jnp.asarray(win.member),
-        jnp.asarray(win.sm_s),
-        jnp.asarray(win.psi),
-        jnp.asarray(win.sm_r),
-        jnp.asarray(win.rounds),
-        jnp.asarray(win.undet),
-        jnp.asarray(win.exists_r),
-        jnp.asarray(win.prior_dec_r),
-        jnp.asarray(win.lb_gate_r),
+    return tuple(
+        jnp.asarray(getattr(win, f)) for f in voting._WIN_FIELDS
     )
 
 
