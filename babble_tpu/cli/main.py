@@ -51,6 +51,7 @@ _RUN_FLAGS = {
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
+    "signal_direct": ("signal_direct", str),
 }
 
 
@@ -263,6 +264,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--signal-ca", dest="signal_ca", default=None,
         help="pinned relay TLS cert (PEM); default datadir/cert.pem if present",
+    )
+    run.add_argument(
+        "--signal-direct", dest="signal_direct", default=None,
+        help="direct p2p upgrade listen addr for signal mode (e.g. "
+        "0.0.0.0:0); gossip then leaves the relay after the handshake",
     )
     run.add_argument(
         "--proxy-listen", dest="proxy_listen", default="127.0.0.1:1338",

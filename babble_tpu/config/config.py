@@ -51,6 +51,9 @@ class Config:
     # can participate without accepting inbound connections.
     signal: bool = False
     signal_addr: str = "127.0.0.1:2443"
+    # Direct-connection upgrade listen address for signal mode (e.g.
+    # "0.0.0.0:0"); empty = gossip stays relayed (pre-upgrade behavior).
+    signal_direct: str = ""
     # Pinned relay TLS certificate (PEM). Defaults to datadir/cert.pem when
     # present (the reference's cert convention, config/config.go:19-32);
     # empty = plaintext relay link.

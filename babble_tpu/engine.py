@@ -103,6 +103,7 @@ class Babble:
                 timeout=self.config.tcp_timeout,
                 join_timeout=self.config.join_timeout,
                 ca_file=ca or None,
+                direct_listen=self.config.signal_direct or None,
             )
         else:
             self.transport = TCPTransport(

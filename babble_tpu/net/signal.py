@@ -24,6 +24,18 @@ SignalTransport. This matches the reference's WAMP signaling posture
 (WSS + TLS with self-signed certs distributed out of band,
 src/net/signal/wamp/client.go:24-120, wamp/wamp.go:1-19).
 
+**Direct-connection upgrade** (``direct_listen=...``): the relay is then
+only the SIGNALING plane, like the reference's WAMP router — nodes
+exchange direct endpoints through it (the SDP offer/answer analogue,
+src/net/webrtc_stream_layer.go:181-236) and upgrade to an authenticated
+peer-to-peer TCP link; all subsequent gossip RPCs ride that link and the
+relay is reduced to a fallback path (it keeps carrying traffic for pairs
+whose direct connect fails, e.g. symmetric NATs — the TURN posture). A
+direct link is mutually authenticated by a two-nonce challenge-response
+(each side signs the other's nonce), so neither endpoint trusts an
+unproven claim to a public key. Once upgraded, gossip keeps committing
+even if the relay dies (tests/test_signal_direct.py pins this).
+
 Threading note (TLS): each socket has exactly ONE reader thread, and all
 writers serialize on the per-socket lock — i.e. at most one SSL_read and
 one SSL_write run concurrently on an SSL object, the classic
@@ -235,6 +247,44 @@ class SignalServer:
             return False
 
 
+def _direct_transcript(role: bytes, nonce_l: bytes, nonce_c: bytes,
+                       signer_pub: str, counterparty_pub: str) -> bytes:
+    """Channel-binding transcript for the direct-link handshake: the
+    signature covers both nonces, the signer's key, AND the counterparty
+    the signer believes it is talking to. Without the counterparty
+    binding, a registered attacker could relay challenge/response pairs
+    between a victim listener and an honest connector and have the victim
+    adopt a link under the honest peer's identity (signature-relay MITM);
+    with it, a relayed signature names the wrong counterparty and fails
+    verification."""
+    return sha256(
+        b"babble-direct|" + role + b"|" + nonce_l + b"|" + nonce_c
+        + b"|" + signer_pub.encode() + b"|" + counterparty_pub.encode()
+    )
+
+
+class _DirectLink:
+    """One mutually-authenticated framed TCP connection to a peer — the
+    data plane after a relay-signaled upgrade (the pion data-channel
+    analogue, webrtc_stream_layer.go:181-236)."""
+
+    __slots__ = ("sock", "wlock", "peer")
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.peer = peer
+
+    def send(self, frame: dict) -> None:
+        _send_frame(self.sock, frame, self.wlock)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class SignalTransport:
     """Transport over a relay server; the local address IS the public key
     (the reference keys WebRTC connections by pubkey the same way,
@@ -254,6 +304,7 @@ class SignalTransport:
         join_timeout: float = 30.0,
         tls: bool = False,
         ca_file: Optional[str] = None,
+        direct_listen: Optional[str] = None,
     ):
         """``key`` is the node's PrivateKey: registration must answer the
         server's challenge with a signature over it. ``ca_file`` (or
@@ -280,6 +331,14 @@ class SignalTransport:
         self._plock = threading.Lock()
         self._next_ch = 0
         self._shutdown = threading.Event()
+        # Direct-connection upgrade (``direct_listen`` e.g. "0.0.0.0:0"):
+        # relay becomes signaling-only once a pair upgrades.
+        self._direct_listen = direct_listen
+        self._direct_listener: Optional[socket.socket] = None
+        self._direct_addr: Optional[str] = None
+        self._direct: Dict[str, _DirectLink] = {}  # peer pub -> link
+        self._dlock = threading.Lock()
+        self._offered: set = set()  # peers we already offered to
 
     # -- Transport interface -------------------------------------------------
 
@@ -315,6 +374,17 @@ class SignalTransport:
                 f"cannot reach signal server {self._server_addr}: {err}"
             ) from err
         threading.Thread(target=self._read_loop, daemon=True).start()
+        if self._direct_listen:
+            host, port_s = self._direct_listen.rsplit(":", 1)
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host or "0.0.0.0", int(port_s)))
+            srv.listen(16)
+            self._direct_listener = srv
+            self._direct_addr = f"{host or '127.0.0.1'}:{srv.getsockname()[1]}"
+            threading.Thread(
+                target=self._direct_accept_loop, daemon=True
+            ).start()
 
     def close(self) -> None:
         self._shutdown.set()
@@ -324,6 +394,193 @@ class SignalTransport:
             except OSError:
                 pass
             self._sock = None
+        if self._direct_listener is not None:
+            try:
+                self._direct_listener.close()
+            except OSError:
+                pass
+            self._direct_listener = None
+        with self._dlock:
+            links, self._direct = list(self._direct.values()), {}
+        for link in links:
+            link.close()
+
+    # -- direct upgrade ------------------------------------------------------
+
+    def _direct_accept_loop(self) -> None:
+        assert self._direct_listener is not None
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._direct_listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._direct_handshake_in, args=(conn,), daemon=True
+            ).start()
+
+    def _direct_handshake_in(self, conn: socket.socket) -> None:
+        """Accepting side of the two-nonce mutual auth: challenge the
+        connector, verify its channel-bound signature (it must name US as
+        the counterparty), then prove our own key over the full
+        transcript."""
+        from ..crypto.keys import PublicKey
+
+        wlock = threading.Lock()
+        try:
+            conn.settimeout(10.0)
+            nonce = os.urandom(32)
+            _send_frame(conn, {"challenge": nonce.hex()}, wlock)
+            hello = _recv_frame(conn)
+            peer = self._norm(hello.get("register") or "")
+            their_nonce = bytes.fromhex(hello.get("nonce", ""))
+            ok = False
+            if peer and len(their_nonce) == 32:
+                try:
+                    ok = PublicKey.from_hex(peer).verify(
+                        _direct_transcript(
+                            b"connect", nonce, their_nonce, peer, self._pub
+                        ),
+                        hello.get("sig", ""),
+                    )
+                except Exception:
+                    ok = False
+            if not ok:
+                conn.close()
+                return
+            _send_frame(
+                conn,
+                {
+                    "register": self._pub,
+                    "sig": self._key.sign(
+                        _direct_transcript(
+                            b"accept", nonce, their_nonce, self._pub, peer
+                        )
+                    ),
+                },
+                wlock,
+            )
+            conn.settimeout(None)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._adopt_link(_DirectLink(conn, peer))
+
+    def _direct_connect(self, peer: str, addr: str) -> None:
+        """Connecting side: authenticate ourselves against the listener's
+        challenge — the signature names ``peer`` as the counterparty, so
+        it is useless to anyone else — and verify the listener proves
+        ``peer``'s key over the same transcript (an endpoint learned
+        through the relay is a claim, not a proof)."""
+        from ..crypto.keys import PublicKey
+
+        try:
+            host, port_s = addr.rsplit(":", 1)
+            conn = socket.create_connection((host, int(port_s)), timeout=5.0)
+            conn.settimeout(10.0)
+            wlock = threading.Lock()
+            challenge = _recv_frame(conn)
+            nonce = bytes.fromhex(challenge.get("challenge", ""))
+            my_nonce = os.urandom(32)
+            _send_frame(
+                conn,
+                {
+                    "register": self._pub,
+                    "sig": self._key.sign(
+                        _direct_transcript(
+                            b"connect", nonce, my_nonce, self._pub, peer
+                        )
+                    ),
+                    "nonce": my_nonce.hex(),
+                },
+                wlock,
+            )
+            proof = _recv_frame(conn)
+            ok = self._norm(proof.get("register") or "") == peer
+            if ok:
+                try:
+                    ok = PublicKey.from_hex(peer).verify(
+                        _direct_transcript(
+                            b"accept", nonce, my_nonce, peer, self._pub
+                        ),
+                        proof.get("sig", ""),
+                    )
+                except Exception:
+                    ok = False
+            if not ok:
+                conn.close()
+                return
+            conn.settimeout(None)
+        except (OSError, ConnectionError, ValueError):
+            return
+        self._adopt_link(_DirectLink(conn, peer))
+
+    def _adopt_link(self, link: _DirectLink) -> None:
+        """Register an authenticated link for outbound routing and start
+        its reader. First link wins; a simultaneous-upgrade duplicate
+        still gets a reader (its peer may route requests over it) but
+        doesn't displace the registered one."""
+        with self._dlock:
+            if link.peer not in self._direct:
+                self._direct[link.peer] = link
+        threading.Thread(
+            target=self._direct_read_loop, args=(link,), daemon=True
+        ).start()
+        logger.info("direct link established with %s", link.peer[:16])
+
+    def _drop_link(self, link: _DirectLink) -> None:
+        with self._dlock:
+            if self._direct.get(link.peer) is link:
+                del self._direct[link.peer]
+            # allow a fresh offer round for this peer
+            self._offered.discard(link.peer)
+        link.close()
+
+    def _direct_read_loop(self, link: _DirectLink) -> None:
+        try:
+            while not self._shutdown.is_set():
+                frame = _recv_frame(link.sock)
+                frame["from"] = link.peer  # identity proven at handshake
+                kind = frame.get("kind")
+                if kind == "resp":
+                    with self._plock:
+                        entry = self._pending.get(frame.get("ch"))
+                    if entry is not None and entry[0] == link.peer:
+                        entry[1].put(frame)
+                elif kind == "req":
+                    threading.Thread(
+                        target=self._serve_request,
+                        args=(frame, link),
+                        daemon=True,
+                    ).start()
+        except (ConnectionError, OSError, ValueError):
+            pass
+        self._drop_link(link)
+
+    def _maybe_offer_direct(self, target: str) -> None:
+        """Send our direct endpoint to ``target`` through the relay (the
+        SDP-offer analogue). One offer per peer per link generation."""
+        if self._direct_addr is None or self._sock is None:
+            return
+        with self._dlock:
+            if target in self._direct or target in self._offered:
+                return
+            self._offered.add(target)
+        try:
+            _send_frame(
+                self._sock,
+                {
+                    "to": target,
+                    "kind": "direct",
+                    "addr": self._direct_addr,
+                },
+                self._wlock,
+            )
+        except (OSError, ConnectionError):
+            with self._dlock:
+                self._offered.discard(target)
 
     # -- inbound -------------------------------------------------------------
 
@@ -362,6 +619,39 @@ class SignalTransport:
                             args=(frame,),
                             daemon=True,
                         ).start()
+                    elif kind == "direct":
+                        # relay-signaled endpoint exchange (SDP-offer
+                        # analogue): try a direct connection, and answer
+                        # with our own endpoint so the peer can try too
+                        # (covers one-sided reachability). Answers are
+                        # not re-answered — no offer loops.
+                        peer = self._norm(frame.get("from") or "")
+                        addr = frame.get("addr")
+                        if peer and addr:
+                            with self._dlock:
+                                have = peer in self._direct
+                            if not have:
+                                threading.Thread(
+                                    target=self._direct_connect,
+                                    args=(peer, addr),
+                                    daemon=True,
+                                ).start()
+                            if not frame.get("answer") and (
+                                self._direct_addr is not None
+                            ):
+                                try:
+                                    _send_frame(
+                                        sock,
+                                        {
+                                            "to": peer,
+                                            "kind": "direct",
+                                            "addr": self._direct_addr,
+                                            "answer": True,
+                                        },
+                                        self._wlock,
+                                    )
+                                except (OSError, ConnectionError):
+                                    pass
             except (ConnectionError, OSError, ValueError):
                 pass
             # relay connection dropped: reconnect with backoff so a signal
@@ -377,43 +667,35 @@ class SignalTransport:
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 5.0)
 
-    def _serve_request(self, frame: dict) -> None:
+    def _serve_request(self, frame: dict,
+                       link: Optional[_DirectLink] = None) -> None:
+        """Serve one inbound RPC; the reply rides the path the request
+        arrived on — the direct ``link`` when given, else the relay."""
         origin = frame.get("from")
         ch = frame.get("ch")
         t = frame.get("t")
+
+        def reply(body, error) -> None:
+            resp = {"ch": ch, "kind": "resp", "t": t, "body": body,
+                    "error": error}
+            try:
+                if link is not None:
+                    link.send(resp)
+                    return
+                sock = self._sock
+                if sock is not None:
+                    _send_frame(sock, {**resp, "to": origin}, self._wlock)
+            except (OSError, ConnectionError):
+                pass
+
         req_cls = REQUEST_TYPES.get(t)
-        sock = self._sock
-        if sock is None:
-            return
         if req_cls is None:
-            _send_frame(
-                sock,
-                {
-                    "to": origin,
-                    "ch": ch,
-                    "kind": "resp",
-                    "t": t,
-                    "body": None,
-                    "error": f"unknown rpc type {t}",
-                },
-                self._wlock,
-            )
+            reply(None, f"unknown rpc type {t}")
             return
         try:
             command = req_cls.from_dict(frame.get("body"))
         except Exception as err:
-            _send_frame(
-                sock,
-                {
-                    "to": origin,
-                    "ch": ch,
-                    "kind": "resp",
-                    "t": t,
-                    "body": None,
-                    "error": f"malformed request body: {err}",
-                },
-                self._wlock,
-            )
+            reply(None, f"malformed request body: {err}")
             return
         rpc = RPC(command)
         self._consumer.put(rpc)
@@ -426,22 +708,7 @@ class SignalTransport:
             result, error = rpc.wait(timeout=wait)
         except queue.Empty:
             result, error = None, "rpc handler timeout"
-        body = result.to_dict() if result is not None else None
-        try:
-            _send_frame(
-                sock,
-                {
-                    "to": origin,
-                    "ch": ch,
-                    "kind": "resp",
-                    "t": t,
-                    "body": body,
-                    "error": error,
-                },
-                self._wlock,
-            )
-        except (OSError, ConnectionError):
-            pass
+        reply(result.to_dict() if result is not None else None, error)
 
     # -- outbound ------------------------------------------------------------
 
@@ -455,18 +722,30 @@ class SignalTransport:
             ch = self._next_ch
             q: "queue.Queue[dict]" = queue.Queue()
             self._pending[ch] = (norm_target, q)
+        msg = {
+            "ch": ch,
+            "kind": "req",
+            "t": type_byte,
+            "body": req.to_dict(),
+        }
         try:
-            _send_frame(
-                self._sock,
-                {
-                    "to": norm_target,
-                    "ch": ch,
-                    "kind": "req",
-                    "t": type_byte,
-                    "body": req.to_dict(),
-                },
-                self._wlock,
-            )
+            # Prefer the direct link once a pair has upgraded; a dead link
+            # drops back to the relay (which also re-arms the offer).
+            with self._dlock:
+                link = self._direct.get(norm_target)
+            sent_direct = False
+            if link is not None:
+                try:
+                    link.send(msg)
+                    sent_direct = True
+                except (OSError, ConnectionError):
+                    self._drop_link(link)
+            if not sent_direct:
+                if self._direct_listen:
+                    self._maybe_offer_direct(norm_target)
+                _send_frame(
+                    self._sock, {**msg, "to": norm_target}, self._wlock
+                )
             try:
                 frame = q.get(timeout=timeout or self._timeout)
             except queue.Empty:

@@ -85,9 +85,10 @@ def test_rpc_roundtrip_via_relay(server):
 
 
 def make_relay_cluster(server, n: int, prefix: str = "sig",
-                       accelerator: bool = False):
+                       accelerator: bool = False, direct: bool = False):
     """n nodes gossiping exclusively through the relay (in signal mode
-    NetAddr carries the pubkey, not host:port)."""
+    NetAddr carries the pubkey, not host:port). ``direct=True`` enables
+    the p2p upgrade (each transport also listens on an ephemeral port)."""
     keys = [generate_key() for _ in range(n)]
     peers = PeerSet(
         [
@@ -104,7 +105,10 @@ def make_relay_cluster(server, n: int, prefix: str = "sig",
             moniker=f"{prefix}{i}",
             accelerator=accelerator,
         )
-        trans = SignalTransport(server.addr(), k)
+        trans = SignalTransport(
+            server.addr(), k,
+            direct_listen="127.0.0.1:0" if direct else None,
+        )
         pr = InmemProxy(DummyState())
         node = Node(
             conf,
