@@ -19,30 +19,50 @@ from typing import List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_SRC = os.path.join(_REPO_ROOT, "native", "secp256k1.cc")
-_SO = os.path.join(_REPO_ROOT, "native", "libbabble_crypto.so")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+_SO_NAME = "libbabble_crypto.so"
+
+# Source / shared-object search order:
+# 1. repo layout (native/ next to the package — development checkouts);
+# 2. installed package data (babble_tpu/_native/, shipped in the wheel;
+#    the wheel build pre-compiles the .so there when a compiler exists).
+_SRC_CANDIDATES = [
+    os.path.join(_REPO_ROOT, "native", "secp256k1.cc"),
+    os.path.join(_PKG_DIR, "_native", "secp256k1.cc"),
+]
+_SRC = next((p for p in _SRC_CANDIDATES if os.path.exists(p)),
+            _SRC_CANDIDATES[0])
+# Build output goes next to the source when that directory is writable
+# (dev checkouts, wheel builds), else to a per-user cache — site-packages
+# is often read-only at runtime.
+_SO = os.path.join(os.path.dirname(_SRC), _SO_NAME)
+_SO_FALLBACK = os.path.join(
+    os.path.expanduser("~"), ".cache", "babble_tpu", "native", _SO_NAME
+)
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _lock = threading.Lock()
 
 
-def _build() -> bool:
+def _build_at(so_path: str) -> bool:
     # Compile to a temp path and rename into place: os.rename is atomic on
     # POSIX, so concurrent node processes never dlopen a half-written .so.
-    tmp = f"{_SO}.tmp.{os.getpid()}"
+    tmp = f"{so_path}.tmp.{os.getpid()}"
     try:
+        os.makedirs(os.path.dirname(so_path), exist_ok=True)
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=60,
         )
-        os.replace(tmp, _SO)
+        os.replace(tmp, so_path)
         return True
     except (OSError, subprocess.SubprocessError) as err:
-        logger.info("native crypto build unavailable: %s", err)
+        logger.info("native crypto build unavailable at %s: %s",
+                    so_path, err)
         try:
             os.unlink(tmp)
         except OSError:
@@ -50,16 +70,34 @@ def _build() -> bool:
         return False
 
 
+def _build() -> bool:
+    global _SO
+    if _build_at(_SO):
+        return True
+    # read-only install dir: build into the user cache instead
+    if _SO != _SO_FALLBACK and _build_at(_SO_FALLBACK):
+        _SO = _SO_FALLBACK
+        return True
+    return False
+
+
+def _stale(so_path: str) -> bool:
+    return not os.path.exists(so_path) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(so_path)
+    )
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
+    global _lib, _tried, _SO
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
+        if _stale(_SO) and not _stale(_SO_FALLBACK):
+            # a prior run already built into the user cache
+            _SO = _SO_FALLBACK
+        if _stale(_SO):
             if not (os.path.exists(_SRC) and _build()):
                 if not os.path.exists(_SO):
                     return None

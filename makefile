@@ -28,4 +28,9 @@ dryrun:
 bench:
 	python bench.py
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench
+# wheel: build the release wheel (native lib bundled+precompiled); the
+# analogue of the reference's scripts/dist.sh release build
+wheel:
+	python -m pip wheel . --no-deps -w dist
+
+.PHONY: native tests test flagtest extratests alltests dryrun bench wheel
