@@ -24,13 +24,28 @@ class TransactionType(enum.IntEnum):
 
 @dataclass
 class InternalTransactionBody:
-    """reference: internal_transaction.go:40-43."""
+    """reference: internal_transaction.go:40-43, plus a uniquifying nonce.
+
+    The reference body is {Type, Peer} only, which makes a validator's
+    join (or leave) itx BYTE-IDENTICAL every time the same peer rejoins —
+    and membership promises are keyed by the itx hash. A rejoining
+    node that fast-forwards and replays a block carrying its own
+    PREVIOUS leave/join then pops the NEW promise with the stale
+    receipt: leave() returns before the new itx was ever published, the
+    node shuts down, and the cluster keeps a ghost validator forever
+    (found by the looped rejoin hunt, tests/test_node_rejoin_loop.py —
+    the reference has the same latent hash collision). The nonce makes
+    every membership request a distinct consensus object."""
 
     type: TransactionType
     peer: Peer
+    nonce: int = 0
 
     def to_dict(self) -> dict:
-        return {"Type": int(self.type), "Peer": self.peer.to_dict()}
+        d = {"Type": int(self.type), "Peer": self.peer.to_dict()}
+        if self.nonce:
+            d["Nonce"] = self.nonce
+        return d
 
     def hash(self) -> bytes:
         return sha256(canonical_dumps(self.to_dict()))
@@ -38,7 +53,9 @@ class InternalTransactionBody:
     @staticmethod
     def from_dict(d: dict) -> "InternalTransactionBody":
         return InternalTransactionBody(
-            type=TransactionType(d["Type"]), peer=Peer.from_dict(d["Peer"])
+            type=TransactionType(d["Type"]),
+            peer=Peer.from_dict(d["Peer"]),
+            nonce=d.get("Nonce", 0),
         )
 
 
@@ -51,12 +68,22 @@ class InternalTransaction:
 
     @staticmethod
     def join(peer: Peer) -> "InternalTransaction":
-        return InternalTransaction(InternalTransactionBody(TransactionType.PEER_ADD, peer))
+        import secrets
+
+        return InternalTransaction(
+            InternalTransactionBody(
+                TransactionType.PEER_ADD, peer, nonce=secrets.randbits(63)
+            )
+        )
 
     @staticmethod
     def leave(peer: Peer) -> "InternalTransaction":
+        import secrets
+
         return InternalTransaction(
-            InternalTransactionBody(TransactionType.PEER_REMOVE, peer)
+            InternalTransactionBody(
+                TransactionType.PEER_REMOVE, peer, nonce=secrets.randbits(63)
+            )
         )
 
     def sign(self, key: PrivateKey) -> None:

@@ -333,8 +333,26 @@ class Core:
         (reference: core.go:416-479). ``lock`` is the owning node's core
         lock, held only while mutating the pools — the consensus wait must
         happen outside it."""
-        p = self.validators.by_id.get(self.validator.id())
-        if p is None or len(self.validators) <= 1 or self.maintenance_mode:
+        if self.maintenance_mode:
+            return
+        # A rejoining node can reach BABBLING (its join was accepted
+        # remotely) while its OWN replay is still catching up through
+        # history — at that instant self.validators may reflect an older
+        # epoch that does not contain us (it may even have just replayed
+        # our previous leave). Treating that stale view as "not a
+        # validator" silently skips the leave and strands a ghost
+        # validator in everyone's peer-set forever (found by the looped
+        # rejoin hunt, tests/test_node_rejoin_loop.py). Wait for the
+        # replay to reach our join before concluding we have nothing to
+        # do — capped below leave_timeout so a node that genuinely never
+        # joined doesn't stall its shutdown for the whole timeout.
+        deadline = time.monotonic() + min(leave_timeout, 5.0)
+        while True:
+            p = self.validators.by_id.get(self.validator.id())
+            if p is not None or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        if p is None or len(self.validators) <= 1:
             return
 
         itx = InternalTransaction.leave(p)

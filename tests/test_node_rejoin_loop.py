@@ -1,0 +1,103 @@
+"""Looped rejoin flake hunt — the analogue of the reference's
+``src/node/test.sh``, which loops its Join/Rejoin node tests up to 100x
+to flush out rare interleavings (state-machine races between the joiner's
+fast-forward, the validators' peer-set rotation, and in-flight gossip).
+
+One validator joins, commits under load, politely leaves, and REJOINS
+with the SAME key, repeatedly. Every iteration must reach BABBLING and
+observe committed transactions; the peer-set must grow and shrink in
+step. BABBLE_FLAKE_ITERS scales the loop for dedicated hunts (default is
+CI-sized)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.state import State
+from babble_tpu.peers.peer_set import PeerSet
+
+from test_node import make_cluster, shutdown_all
+from test_node_churn import check_peer_sets
+from test_node_dyn import Bombardier, make_extra_node, wait_until
+
+ITERS = int(os.environ.get("BABBLE_FLAKE_ITERS", "4"))
+
+
+def test_rejoin_loop_same_key():
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    rejoin_key = generate_key()
+    bomb = Bombardier(proxies).start()
+    joiner = None
+    try:
+        for n in nodes:
+            n.run_async()
+        wait_until(
+            lambda: all(n.get_last_block_index() >= 0 for n in nodes),
+            60.0,
+            "base cluster never committed",
+        )
+        import sys
+        t_start = time.monotonic()
+        for it in range(ITERS):
+            print(f"[rejoin it{it}] t={time.monotonic()-t_start:.1f}s "
+                  f"blocks={[n.get_last_block_index() for n in nodes]} "
+                  f"peers={[len(n.core.peers.peers) for n in nodes]}",
+                  file=sys.stderr, flush=True)
+            joiner, jp = make_extra_node(
+                network,
+                PeerSet(list(nodes[0].core.peers.peers)),
+                nodes[0].core.genesis_peers,
+                f"rejoiner-it{it}",  # moniker may differ; the KEY rejoins
+                key=rejoin_key,
+            )
+            joiner.run_async()
+            wait_until(
+                lambda: joiner.get_state() == State.BABBLING,
+                90.0,
+                f"iteration {it}: rejoiner never reached BABBLING",
+            )
+            live = nodes + [joiner]
+            check_peer_sets(live)
+            assert all(
+                len(n.core.peers.peers) == 4 for n in live
+            ), f"iteration {it}: join not reflected in peer-sets"
+
+            # the rejoiner must observe progress, not just sit in the set
+            base = joiner.get_last_block_index()
+            wait_until(
+                lambda: joiner.get_last_block_index() > base,
+                60.0,
+                f"iteration {it}: rejoiner committed nothing",
+            )
+
+            print(f"[rejoin it{it}] pre-leave t={time.monotonic()-t_start:.1f}s "
+                  f"joiner_blocks={joiner.get_last_block_index()}",
+                  file=sys.stderr, flush=True)
+            joiner.leave()
+            print(f"[rejoin it{it}] post-leave t={time.monotonic()-t_start:.1f}s "
+                  f"removed_round={joiner.core.removed_round}",
+                  file=sys.stderr, flush=True)
+            wait_until(
+                lambda: all(
+                    len(n.core.peers.peers) == 3 for n in nodes
+                ),
+                60.0,
+                f"iteration {it}: leave not reflected in peer-sets",
+            )
+            joiner = None
+            # the remaining cluster must still be live after the cycle
+            mark = min(n.get_last_block_index() for n in nodes)
+            wait_until(
+                lambda: min(n.get_last_block_index() for n in nodes) > mark,
+                60.0,
+                f"iteration {it}: cluster stalled after leave",
+            )
+    finally:
+        bomb.stop()
+        if joiner is not None:
+            joiner.shutdown()
+        shutdown_all(nodes)
