@@ -82,10 +82,21 @@ def _recv_frame(sock: socket.socket) -> dict:
     return frame
 
 
-def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock) -> None:
+def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock,
+                timeout: Optional[float] = None) -> None:
+    """Framed send; with ``timeout`` the whole write is bounded (the
+    timeout is set inside the per-socket lock so concurrent writers never
+    race the setting — used by the relay to drop jammed destinations)."""
     payload = canonical_dumps(obj)
     with lock:
-        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        if timeout is not None:
+            sock.settimeout(timeout)
+            try:
+                sock.sendall(struct.pack(">I", len(payload)) + payload)
+            finally:
+                sock.settimeout(None)
+        else:
+            sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
 class SignalServer:
@@ -93,11 +104,18 @@ class SignalServer:
     (reference: src/net/signal/wamp/server.go:18-98)."""
 
     def __init__(self, bind_addr: str, cert_file: Optional[str] = None,
-                 key_file: Optional[str] = None):
+                 key_file: Optional[str] = None,
+                 send_timeout: float = 10.0):
         """``cert_file``/``key_file``: optional PEM pair; when given, every
         client connection is wrapped in TLS (reference posture:
-        wamp/server.go serves WSS with a provided cert)."""
+        wamp/server.go serves WSS with a provided cert).
+
+        ``send_timeout``: forwarding to a destination that has stopped
+        draining its socket times out and DROPS that destination instead
+        of wedging the sender's relay thread — without it one dead reader
+        head-of-line-blocks every peer that gossips to it."""
         self._bind_addr = bind_addr
+        self._send_timeout = send_timeout
         self._listener: Optional[socket.socket] = None
         self._clients: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
@@ -202,10 +220,15 @@ class SignalServer:
                 delivered = False
                 if dest is not None:
                     try:
-                        _send_frame(dest[0], frame, dest[1])
+                        # bounded send: a full kernel buffer (dest stopped
+                        # reading) must drop the dest, not wedge this
+                        # sender's relay thread
+                        _send_frame(dest[0], frame, dest[1],
+                                    timeout=self._send_timeout)
                         delivered = True
                     except (OSError, ConnectionError):
-                        # the DESTINATION is dead — drop it, not the sender
+                        # the DESTINATION is dead or jammed — drop it, not
+                        # the sender
                         with self._lock:
                             if self._clients.get(target, (None,))[0] is dest[0]:
                                 del self._clients[target]
@@ -476,6 +499,7 @@ class SignalTransport:
         through the relay is a claim, not a proof)."""
         from ..crypto.keys import PublicKey
 
+        conn = None
         try:
             host, port_s = addr.rsplit(":", 1)
             conn = socket.create_connection((host, int(port_s)), timeout=5.0)
@@ -514,17 +538,26 @@ class SignalTransport:
                 return
             conn.settimeout(None)
         except (OSError, ConnectionError, ValueError):
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             return
         self._adopt_link(_DirectLink(conn, peer))
 
     def _adopt_link(self, link: _DirectLink) -> None:
         """Register an authenticated link for outbound routing and start
-        its reader. First link wins; a simultaneous-upgrade duplicate
-        still gets a reader (its peer may route requests over it) but
-        doesn't displace the registered one."""
+        its reader. Latest link wins: after an asymmetric failure (the
+        peer saw the error and redialed, we did not) a first-wins policy
+        would let the stale registered link shadow the fresh one forever;
+        replacing closes the old link (any reply in flight on it fails
+        and the requester retries via the relay)."""
         with self._dlock:
-            if link.peer not in self._direct:
-                self._direct[link.peer] = link
+            old = self._direct.get(link.peer)
+            self._direct[link.peer] = link
+        if old is not None and old is not link:
+            old.close()
         threading.Thread(
             target=self._direct_read_loop, args=(link,), daemon=True
         ).start()
@@ -624,10 +657,15 @@ class SignalTransport:
                         # analogue): try a direct connection, and answer
                         # with our own endpoint so the peer can try too
                         # (covers one-sided reachability). Answers are
-                        # not re-answered — no offer loops.
+                        # not re-answered — no offer loops. Nodes WITHOUT
+                        # direct_listen ignore offers entirely: "empty =
+                        # gossip stays relayed" is an operator promise
+                        # (egress policy), and honoring a peer's offer
+                        # would let any registered key make this node dial
+                        # an arbitrary address.
                         peer = self._norm(frame.get("from") or "")
                         addr = frame.get("addr")
-                        if peer and addr:
+                        if self._direct_listen and peer and addr:
                             with self._dlock:
                                 have = peer in self._direct
                             if not have:

@@ -90,6 +90,34 @@ def test_direct_disabled_keeps_relay_only(server):
         tb.close()
 
 
+def test_relay_only_node_ignores_offers(server):
+    """A node configured WITHOUT direct_listen must never dial out in
+    response to a peer's direct offer: "empty = gossip stays relayed" is
+    an operator promise (egress policy), and honoring offers would let
+    any registered key steer the node to an arbitrary address."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka, timeout=20.0)  # relay-only
+    tb = SignalTransport(server.addr(), kb, timeout=20.0,
+                         direct_listen="127.0.0.1:0")
+    ta.listen()
+    tb.listen()
+    stop = threading.Event()
+    _responder(ta, stop)
+    try:
+        # B's request offers its endpoint to A; A must not upgrade
+        resp = tb.sync(ka.public_key.hex(), SyncRequest(1, {}, 100))
+        assert isinstance(resp, SyncResponse)
+        time.sleep(0.5)
+        with ta._dlock:
+            assert not ta._direct, "relay-only node dialed a direct link"
+        with tb._dlock:
+            assert not tb._direct
+    finally:
+        stop.set()
+        ta.close()
+        tb.close()
+
+
 def test_direct_connect_rejects_wrong_identity(server):
     """A listener that can't prove the expected key is rejected: the
     connector learned the endpoint through the relay, which is a claim,
