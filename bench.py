@@ -254,6 +254,10 @@ def bench_gossip(
             "accel_avg_sweep_ms",
             "accel_last_window_events",
             "accel_stage_ms",
+            "accel_min_window",
+            "accel_pipeline",
+            "accel_batcher",
+            "accel_pallas",
         ):
             if key in ("accel_sweeps", "accel_fallbacks"):
                 out[key] = sum(int(s.get(key) or 0) for s in stats)
@@ -1177,6 +1181,40 @@ def main() -> None:
         accel = {"error": f"{type(err).__name__}: {err}"}
         print(f"accelerated bench failed: {err}", file=sys.stderr)
 
+    # Steady-state engagement capture: the same 4-node accelerated run
+    # with the window gate forced down to 64, so the device (pipelined +
+    # batched on real accelerators) participates in steady state instead
+    # of only on backlogs. Profiling shows consensus voting is a small
+    # share of host time at this scale (GIL + insert path dominate), so
+    # this records the measured cost/benefit of early engagement rather
+    # than assuming it.
+    prev_mw = os.environ.get("BABBLE_ACCEL_MIN_WINDOW")
+    try:
+        os.environ["BABBLE_ACCEL_MIN_WINDOW"] = "64"
+        # best-of-two like its comparator accelerated_4node: a single run
+        # on one side would read as up to ~10% scheduling noise
+        mw64_runs = [bench_gossip(accelerator=True),
+                     bench_gossip(accelerator=True)]
+        accel_mw64 = max(mw64_runs, key=lambda r: r["txs_per_s"])
+        accel_mw64["runs_txs_per_s"] = [r["txs_per_s"] for r in mw64_runs]
+        accel_mw64["accel_min_window_forced"] = 64
+        print(
+            f"4-node accelerated (min_window=64): "
+            f"{accel_mw64['txs_per_s']} tx/s "
+            f"(runs: {accel_mw64['runs_txs_per_s']}) "
+            f"sweeps={accel_mw64['accel_sweeps']} "
+            f"small={accel_mw64['accel_small_windows']}",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        accel_mw64 = {"error": f"{type(err).__name__}: {err}"}
+        print(f"accelerated mw64 bench failed: {err}", file=sys.stderr)
+    finally:
+        if prev_mw is None:
+            os.environ.pop("BABBLE_ACCEL_MIN_WINDOW", None)
+        else:
+            os.environ["BABBLE_ACCEL_MIN_WINDOW"] = prev_mw
+
     # Open-loop latency below capacity: saturated p50 measures queue depth;
     # this is the commit latency a user would actually see at 1k tx/s.
     try:
@@ -1348,6 +1386,7 @@ def main() -> None:
         "latency_p50_ms": oracle["latency_p50_ms"],
         "latency_p95_ms": oracle["latency_p95_ms"],
         "accelerated_4node": accel,
+        "accelerated_4node_mw64": accel_mw64,
         "latency_at_1k_offered": latency_at_1k,
         "sweep_crossover": crossover,
         "config3_16node_threads": config3_threads,

@@ -1,0 +1,129 @@
+"""Cross-feature matrix cells: placement of the voting computation
+(oracle / device sweep / batched sweep), the storage backend, and the
+transport must be pairwise orthogonal — consensus output identical in
+every combination. Each test pins one cell the individual suites don't
+cover together.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+from babble_tpu.hashgraph.accel import TensorConsensus
+from babble_tpu.hashgraph.persistent_store import PersistentStore
+
+from test_accel import BUILDERS, _consensus_state, _ordered_events, _replay
+
+
+@pytest.mark.parametrize("graph", ["consensus", "funky_full"])
+def test_accel_with_persistent_store_matches_oracle(graph, tmp_path):
+    """Device sweeps writing through the SQLite store: decisions and the
+    DB contents must match the oracle+inmem replay (the apply paths do
+    two-phase writes precisely so a persistent store can't tear)."""
+    h0, index, nodes, peer_set = BUILDERS[graph]()
+    ordered = _ordered_events(h0)
+    oracle = _replay(ordered, peer_set)
+
+    store = PersistentStore(
+        cache_size=1000, path=str(tmp_path / f"{graph}.db")
+    )
+    h = Hashgraph(store)
+    h.init(peer_set)
+    h.accel = TensorConsensus(sweep_events=8, async_compile=False,
+                              min_window=0)
+    for ev in ordered:
+        e = Event(ev.body, ev.signature)
+        h.insert_event_and_run_consensus(e, set_wire_info=True)
+    h.flush_consensus()
+    assert h.accel.fallbacks == 0
+    assert h.accel.sweeps > 0
+    assert _consensus_state(h) == _consensus_state(oracle)
+
+    # and the DB round-trips the device-decided state (cold reopen)
+    store.close()
+    cold = PersistentStore(cache_size=1000, path=str(tmp_path / f"{graph}.db"))
+    try:
+        assert cold.db_last_block_index() == (
+            oracle.store.last_block_index()
+        )
+    finally:
+        cold.close()
+
+
+def test_batched_accel_gossip_cluster():
+    """Live 4-node inmem cluster where every node's sweeps ride the
+    co-located batcher (BABBLE_ACCEL_BATCH=1): blocks must commit and be
+    byte-identical, with zero device fallbacks."""
+    import os
+
+    from babble_tpu.net.inmem import InmemNetwork
+    from test_node import bombard_and_wait, check_gossip, make_cluster, \
+        shutdown_all
+
+    os.environ["BABBLE_ACCEL_BATCH"] = "1"
+    try:
+        network = InmemNetwork()
+        nodes, proxies, _ = make_cluster(4, network, accelerator=True)
+        for n in nodes:
+            n.core.hg.accel = TensorConsensus(async_compile=False,
+                                              min_window=0, batcher=True)
+        try:
+            for n in nodes:
+                n.run_async()
+            bombard_and_wait(nodes, proxies, target_block=2, timeout=90.0)
+            check_gossip(nodes, 0, 2)
+            assert all(n.core.hg.accel.fallbacks == 0 for n in nodes)
+            assert any(n.core.hg.accel.sweeps > 0 for n in nodes)
+        finally:
+            shutdown_all(nodes)
+    finally:
+        os.environ.pop("BABBLE_ACCEL_BATCH", None)
+
+
+def test_direct_upgrade_with_accelerator():
+    """Transport x engine matrix: device consensus sweeps riding the
+    DIRECT p2p links after a relay-signaled upgrade — and still committing
+    after the relay dies. Placement of the voting computation must be
+    orthogonal to how gossip moves."""
+    from babble_tpu.net.signal import SignalServer
+    from test_node import bombard_and_wait, check_gossip, shutdown_all
+    from test_signal import make_relay_cluster
+
+    srv = SignalServer("127.0.0.1:0")
+    srv.listen()
+    nodes, proxies = make_relay_cluster(srv, 2, prefix="dacc",
+                                        accelerator=True, direct=True)
+    for node in nodes:
+        node.core.hg.accel = TensorConsensus(async_compile=False,
+                                             min_window=0)
+    try:
+        for n in nodes:
+            n.run_async()
+        bombard_and_wait(nodes, proxies, target_block=1, timeout=90.0)
+
+        def all_direct():
+            for n in nodes:
+                with n.trans._dlock:
+                    if not n.trans._direct:
+                        return False
+            return True
+
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not all_direct():
+            time.sleep(0.2)
+        assert all_direct(), "pair never upgraded to a direct link"
+        srv.close()
+        time.sleep(0.3)
+        mark = max(n.get_last_block_index() for n in nodes)
+        bombard_and_wait(nodes, proxies, target_block=mark + 1, timeout=60.0)
+        check_gossip(nodes, 0, mark + 1)
+        for n in nodes:
+            assert n.core.hg.accel.sweeps > 0
+            assert n.core.hg.accel.fallbacks == 0
+    finally:
+        shutdown_all(nodes)
+        srv.close()
