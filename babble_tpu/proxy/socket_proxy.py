@@ -68,6 +68,8 @@ class JsonRpcServer:
         try:
             while not self._shutdown.is_set():
                 msg = _recv_msg(conn)
+                if not isinstance(msg, dict):
+                    break  # protocol violation: drop this client
                 mid = msg.get("id")
                 fn = self._handlers.get(msg.get("method", ""))
                 if fn is None:
@@ -81,7 +83,9 @@ class JsonRpcServer:
                     _send_msg(conn, {"result": result, "error": None, "id": mid})
                 except Exception as err:  # handler error crosses the wire as a string
                     _send_msg(conn, {"result": None, "error": str(err), "id": mid})
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, ValueError, struct.error):
+            # garbage framing or undecodable JSON from a client drops THAT
+            # client; the accept loop (and every other client) lives on
             pass
         finally:
             try:

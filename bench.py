@@ -1148,35 +1148,31 @@ def _device_verify_inner(n_sigs: int = 256, reps: int = 5):
     return out
 
 
+def _best_of_two(label: str, **gossip_kwargs) -> dict:
+    """Best of two bench_gossip runs: thread scheduling on a shared
+    single-core host swings a single 2-3 s measurement window by +/-10%;
+    the better run is the honest capability number, both are recorded,
+    and EVERY compared capture uses the same protocol so no side gains a
+    selection-effect advantage."""
+    runs = [bench_gossip(**gossip_kwargs), bench_gossip(**gossip_kwargs)]
+    best = max(runs, key=lambda r: r["txs_per_s"])
+    best["runs_txs_per_s"] = [r["txs_per_s"] for r in runs]
+    print(
+        f"{label}: {best['txs_per_s']} tx/s "
+        f"(runs: {best['runs_txs_per_s']}) "
+        f"p50={best['latency_p50_ms']}ms p95={best['latency_p95_ms']}ms",
+        file=sys.stderr,
+    )
+    return best
+
+
 def main() -> None:
     if "--all" in sys.argv:
         return main_all()
     device_info = _resolve_bench_device()
-    # Best of two runs: thread scheduling on a shared single-core host
-    # swings a single 2-3 s measurement window by +/-10%; the better run is
-    # the honest capability number, and both are recorded.
-    oracle_runs = [bench_gossip(), bench_gossip()]
-    oracle = max(oracle_runs, key=lambda r: r["txs_per_s"])
-    oracle["runs_txs_per_s"] = [r["txs_per_s"] for r in oracle_runs]
-    print(
-        f"4-node oracle path: {oracle['txs_per_s']} tx/s "
-        f"(runs: {oracle['runs_txs_per_s']}) "
-        f"p50={oracle['latency_p50_ms']}ms p95={oracle['latency_p95_ms']}ms",
-        file=sys.stderr,
-    )
+    oracle = _best_of_two("4-node oracle path")
     try:
-        # same best-of-two capture as the oracle so the comparison is not
-        # biased by selection effect on one side
-        accel_runs = [bench_gossip(accelerator=True),
-                      bench_gossip(accelerator=True)]
-        accel = max(accel_runs, key=lambda r: r["txs_per_s"])
-        accel["runs_txs_per_s"] = [r["txs_per_s"] for r in accel_runs]
-        print(
-            f"4-node accelerated: {accel['txs_per_s']} tx/s "
-            f"(runs: {accel['runs_txs_per_s']}) "
-            f"p50={accel['latency_p50_ms']}ms sweeps={accel['accel_sweeps']}",
-            file=sys.stderr,
-        )
+        accel = _best_of_two("4-node accelerated", accelerator=True)
     except Exception as err:
         accel = {"error": f"{type(err).__name__}: {err}"}
         print(f"accelerated bench failed: {err}", file=sys.stderr)
@@ -1191,21 +1187,10 @@ def main() -> None:
     prev_mw = os.environ.get("BABBLE_ACCEL_MIN_WINDOW")
     try:
         os.environ["BABBLE_ACCEL_MIN_WINDOW"] = "64"
-        # best-of-two like its comparator accelerated_4node: a single run
-        # on one side would read as up to ~10% scheduling noise
-        mw64_runs = [bench_gossip(accelerator=True),
-                     bench_gossip(accelerator=True)]
-        accel_mw64 = max(mw64_runs, key=lambda r: r["txs_per_s"])
-        accel_mw64["runs_txs_per_s"] = [r["txs_per_s"] for r in mw64_runs]
-        accel_mw64["accel_min_window_forced"] = 64
-        print(
-            f"4-node accelerated (min_window=64): "
-            f"{accel_mw64['txs_per_s']} tx/s "
-            f"(runs: {accel_mw64['runs_txs_per_s']}) "
-            f"sweeps={accel_mw64['accel_sweeps']} "
-            f"small={accel_mw64['accel_small_windows']}",
-            file=sys.stderr,
+        accel_mw64 = _best_of_two(
+            "4-node accelerated (min_window=64)", accelerator=True
         )
+        accel_mw64["accel_min_window_forced"] = 64
     except Exception as err:
         accel_mw64 = {"error": f"{type(err).__name__}: {err}"}
         print(f"accelerated mw64 bench failed: {err}", file=sys.stderr)

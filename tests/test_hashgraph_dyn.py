@@ -459,6 +459,29 @@ def test_dyn_accel_matches_oracle(script, mode):
 
 
 @pytest.mark.parametrize("script", list(SCRIPTS))
+def test_dyn_accel_batched_matches_oracle(script):
+    """The golden dynamic-membership fixtures through the co-located
+    SWEEP BATCHER: multi-slot windows (psi/member machinery) re-padded to
+    the batcher's monotone bucket and dispatched vmapped must reproduce
+    the oracle bit for bit across join/leave — pins repad_window's S/R
+    padding under real peer-set churn."""
+    steps, index = SCRIPTS[script]()
+    steps = _preregister(steps)
+    oracle = _build(steps, run_consensus=True)
+    accel = TensorConsensus(
+        sweep_events=3,
+        async_compile=False,
+        min_window=0,
+        pipeline=False,
+        batcher=True,
+    )
+    dev = _build(steps, accel=accel, run_consensus=True)
+    assert accel.sweeps > 0
+    assert accel.fallbacks == 0
+    assert _consensus_state(dev) == _consensus_state(oracle)
+
+
+@pytest.mark.parametrize("script", list(SCRIPTS))
 def test_dyn_accel_mesh_sharded_matches_oracle(script):
     """The golden dynamic-membership fixtures through the MESH-SHARDED
     voting kernel: witness-axis shard_map sweeps with per-round peer-set

@@ -7,12 +7,11 @@ cover together.
 
 from __future__ import annotations
 
-import threading
 import time
 
 import pytest
 
-from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+from babble_tpu.hashgraph import Event, Hashgraph
 from babble_tpu.hashgraph.accel import TensorConsensus
 from babble_tpu.hashgraph.persistent_store import PersistentStore
 
@@ -64,6 +63,7 @@ def test_batched_accel_gossip_cluster():
     from test_node import bombard_and_wait, check_gossip, make_cluster, \
         shutdown_all
 
+    prev = os.environ.get("BABBLE_ACCEL_BATCH")
     os.environ["BABBLE_ACCEL_BATCH"] = "1"
     try:
         network = InmemNetwork()
@@ -81,7 +81,10 @@ def test_batched_accel_gossip_cluster():
         finally:
             shutdown_all(nodes)
     finally:
-        os.environ.pop("BABBLE_ACCEL_BATCH", None)
+        if prev is None:
+            os.environ.pop("BABBLE_ACCEL_BATCH", None)
+        else:
+            os.environ["BABBLE_ACCEL_BATCH"] = prev
 
 
 def test_direct_upgrade_with_accelerator():
