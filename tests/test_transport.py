@@ -1,6 +1,7 @@
-"""Table-driven transport suite: every RPC pair over both the inmem and
-TCP transports (reference: /root/reference/src/net/transport_test.go:91-520),
-plus a full-node gossip run over localhost TCP (node_test.go tier 4)."""
+"""Table-driven transport suite: every RPC pair over the inmem, TCP,
+relay, and relay-with-direct-upgrade transports (reference:
+/root/reference/src/net/transport_test.go:91-520), plus a full-node
+gossip run over localhost TCP (node_test.go tier 4)."""
 
 from __future__ import annotations
 
@@ -78,17 +79,22 @@ def _make_pair(kind):
         a = net.new_transport("inmem://a")
         b = net.new_transport("inmem://b")
         return a, b, lambda: (a.close(), b.close())
-    if kind == "signal":
+    if kind in ("signal", "signal-direct"):
         # relay-routed pair: both sides dial OUT to a rendezvous server
-        # and are addressed by public key (the WebRTC analogue)
+        # and are addressed by public key (the WebRTC analogue).
+        # "signal-direct" additionally enables the p2p upgrade, so after
+        # the first RPC the suite's traffic rides the direct links.
         from babble_tpu.crypto.keys import generate_key
         from babble_tpu.net.signal import SignalServer, SignalTransport
 
+        direct = "127.0.0.1:0" if kind == "signal-direct" else None
         relay = SignalServer("127.0.0.1:0")
         relay.listen()
         ka, kb = generate_key(), generate_key()
-        a = SignalTransport(relay.addr(), ka, timeout=20.0)
-        b = SignalTransport(relay.addr(), kb, timeout=20.0)
+        a = SignalTransport(relay.addr(), ka, timeout=20.0,
+                            direct_listen=direct)
+        b = SignalTransport(relay.addr(), kb, timeout=20.0,
+                            direct_listen=direct)
         a.listen()
         b.listen()
         return a, b, lambda: (a.close(), b.close(), relay.close())
@@ -99,7 +105,7 @@ def _make_pair(kind):
     return cli, srv, lambda: (cli.close(), srv.close())
 
 
-@pytest.fixture(params=["inmem", "tcp", "signal"])
+@pytest.fixture(params=["inmem", "tcp", "signal", "signal-direct"])
 def pair(request):
     cli, srv, cleanup = _make_pair(request.param)
     stop = threading.Event()
