@@ -535,6 +535,7 @@ class SignalTransport:
                     ok = False
             if not ok:
                 conn.close()
+                self._rearm_offer(peer)
                 return
             conn.settimeout(None)
         except (OSError, ConnectionError, ValueError):
@@ -543,8 +544,16 @@ class SignalTransport:
                     conn.close()
                 except OSError:
                     pass
+            self._rearm_offer(peer)
             return
         self._adopt_link(_DirectLink(conn, peer))
+
+    def _rearm_offer(self, peer: str) -> None:
+        """A failed connect must not leave ``peer`` stuck in the offered
+        set: with no link AND no pending offer the pair could never
+        upgrade again until some other event cleared it."""
+        with self._dlock:
+            self._offered.discard(peer)
 
     def _adopt_link(self, link: _DirectLink) -> None:
         """Register an authenticated link for outbound routing and start

@@ -1,0 +1,97 @@
+"""Randomized churn storm — the analogue of the reference's Extra-gated
+endurance suite (node_extra_test.go:30-332, run via `make extratests`):
+a base cluster under continuous load while extra validators join, leave
+politely, or are killed outright, in random order. Liveness (the base
+cluster keeps committing), safety (byte-identical blocks), and peer-set
+agreement are asserted after every storm phase.
+
+Sized for CI; BABBLE_STORM_CYCLES scales it up for endurance hunts.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.state import State
+from babble_tpu.peers.peer_set import PeerSet
+
+from test_node import check_gossip, make_cluster, shutdown_all
+from test_node_churn import check_peer_sets
+from test_node_dyn import Bombardier, make_extra_node, wait_until
+
+CYCLES = int(os.environ.get("BABBLE_STORM_CYCLES", "3"))
+
+
+def test_churn_storm_random_join_leave_kill():
+    rng = random.Random(0xBABB1E)
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    bomb = Bombardier(proxies).start()
+    extras = []  # currently alive extra validators
+    storm_killed = False  # one crash-departure per storm (see guard below)
+    try:
+        for n in nodes:
+            n.run_async()
+        wait_until(
+            lambda: all(n.get_last_block_index() >= 0 for n in nodes),
+            60.0,
+            "base cluster never committed",
+        )
+        for cycle in range(CYCLES):
+            # join 1-2 extra validators
+            for j in range(rng.randint(1, 2)):
+                joiner, _ = make_extra_node(
+                    network,
+                    PeerSet(list(nodes[0].core.peers.peers)),
+                    nodes[0].core.genesis_peers,
+                    f"storm-{cycle}-{j}",
+                    key=generate_key(),
+                )
+                joiner.run_async()
+                wait_until(
+                    lambda: joiner.get_state() == State.BABBLING,
+                    90.0,
+                    f"cycle {cycle}: joiner {j} never reached BABBLING",
+                )
+                extras.append(joiner)
+            check_peer_sets(nodes + extras)
+
+            # depart: polite leave, or (once per STORM) an outright kill.
+            # A killed validator stays in the set forever — there is no
+            # eviction — so the super-majority threshold rises relative
+            # to the live membership with every kill; after one kill the
+            # 3-base-node cluster can never afford another. The guard
+            # uses the canonical threshold, and the single crash is a
+            # deliberate bound, not a per-cycle budget.
+            while extras:
+                victim = extras.pop(rng.randrange(len(extras)))
+                sm = nodes[0].core.peers.super_majority()
+                alive_after_kill = len(nodes) + len(extras)
+                if not storm_killed and alive_after_kill >= sm and (
+                    rng.random() < 0.5
+                ):
+                    victim.shutdown()  # crash-style departure
+                    storm_killed = True
+                else:
+                    victim.leave()
+            # the base cluster must stay live regardless of HOW extras
+            # departed
+            mark = min(n.get_last_block_index() for n in nodes)
+            wait_until(
+                lambda: min(n.get_last_block_index() for n in nodes)
+                > mark + 1,
+                90.0,
+                f"cycle {cycle}: base cluster stalled after churn",
+            )
+        # safety across everything that happened
+        to_block = min(n.get_last_block_index() for n in nodes)
+        check_gossip(nodes, 0, to_block)
+    finally:
+        bomb.stop()
+        for e in extras:
+            e.shutdown()
+        shutdown_all(nodes)

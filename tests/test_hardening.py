@@ -74,9 +74,11 @@ def test_direct_upgrade_over_tls_relay(tmp_path):
     srv = SignalServer("127.0.0.1:0", cert_file=cert_file, key_file=key_file)
     srv.listen()
     ka, kb = generate_key(), generate_key()
-    ta = SignalTransport(srv.addr(), ka, timeout=20.0, ca_file=cert_file,
+    # 40 s RPC budget: under a full-suite run on this single core the TLS
+    # handshakes + responder threads can stall for tens of seconds
+    ta = SignalTransport(srv.addr(), ka, timeout=40.0, ca_file=cert_file,
                          direct_listen="127.0.0.1:0")
-    tb = SignalTransport(srv.addr(), kb, timeout=20.0, ca_file=cert_file,
+    tb = SignalTransport(srv.addr(), kb, timeout=40.0, ca_file=cert_file,
                          direct_listen="127.0.0.1:0")
     ta.listen()
     tb.listen()

@@ -30,7 +30,9 @@ def server():
     srv.close()
 
 
-def _wait_direct(trans: SignalTransport, peer_pub: str, timeout=10.0) -> bool:
+def _wait_direct(trans: SignalTransport, peer_pub: str, timeout=30.0) -> bool:
+    # default sized for a single shared CPU core: concurrent suites can
+    # stall the handshake threads for seconds
     deadline = time.monotonic() + timeout
     peer = trans._norm(peer_pub)
     while time.monotonic() < deadline:
@@ -88,6 +90,72 @@ def test_direct_disabled_keeps_relay_only(server):
         stop.set()
         ta.close()
         tb.close()
+
+
+def test_offer_rearms_after_link_drop(server):
+    """A dropped direct link clears the offered-set, so the NEXT request
+    re-offers through the relay and the pair re-upgrades — the relay
+    remains the always-available recovery path."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka, timeout=20.0,
+                         direct_listen="127.0.0.1:0")
+    tb = SignalTransport(server.addr(), kb, timeout=20.0,
+                         direct_listen="127.0.0.1:0")
+    ta.listen()
+    tb.listen()
+    stop = threading.Event()
+    _responder(tb, stop)
+    try:
+        ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 100))
+        assert _wait_direct(ta, kb.public_key.hex())
+        peer = ta._norm(kb.public_key.hex())
+        with ta._dlock:
+            link = ta._direct[peer]
+        # sever the link out from under A (B's side errors too and drops)
+        link.sock.close()
+        time.sleep(0.3)
+        # next RPC: A detects the dead link (or its reader already
+        # dropped it), falls back to the relay, and re-offers
+        resp = ta.sync(kb.public_key.hex(), SyncRequest(2, {}, 100))
+        assert isinstance(resp, SyncResponse)
+        assert _wait_direct(ta, kb.public_key.hex(), timeout=20.0), (
+            "pair never re-upgraded after the link drop"
+        )
+        # and the fresh link really carries traffic with the relay gone
+        server.close()
+        time.sleep(0.2)
+        resp = ta.sync(kb.public_key.hex(), SyncRequest(3, {}, 100))
+        assert isinstance(resp, SyncResponse)
+    finally:
+        stop.set()
+        ta.close()
+        tb.close()
+
+
+def test_failed_dial_rearms_offer(server):
+    """The stuck-offer regression itself: a dial that fails BEFORE any
+    link exists (unreachable direct addr) must clear the offered-set so
+    a later RPC can re-offer — with the _rearm_offer fix reverted, the
+    peer stays stuck in _offered forever and the pair can never
+    upgrade."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka, timeout=5.0,
+                         direct_listen="127.0.0.1:0")
+    ta.listen()
+    peer = ta._norm(kb.public_key.hex())
+    with ta._dlock:
+        ta._offered.add(peer)  # an offer is outstanding...
+    # ...and the answer's dial hits a dead port (connection refused)
+    ta._direct_connect(peer, "127.0.0.1:9")
+    try:
+        with ta._dlock:
+            assert peer not in ta._direct
+            assert peer not in ta._offered, (
+                "failed dial left the offer stuck; the pair could never "
+                "re-attempt an upgrade"
+            )
+    finally:
+        ta.close()
 
 
 def test_relay_only_node_ignores_offers(server):

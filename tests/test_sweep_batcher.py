@@ -172,6 +172,39 @@ def test_batcher_unwarmed_degrades_to_singles():
     assert svc.compile_kicks >= 1
 
 
+def test_batcher_dispatch_failure_fails_tickets_not_daemon(monkeypatch):
+    """A device failure mid-batch must error every ticket in the wave
+    (the owning nodes fall back to their oracles) and leave the
+    dispatcher thread alive for the next wave."""
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+    from babble_tpu.ops import voting
+
+    wins = _two_windows()
+    key = voting.bucket_key(wins[0])
+    voting.precompile_batched(SweepBatcher.MAX_BATCH, *key)
+
+    svc = SweepBatcher()
+
+    def boom(*a, **k):
+        raise RuntimeError("device fell off the bus")
+
+    monkeypatch.setattr(voting, "launch_batched", boom)
+    monkeypatch.setattr(voting, "launch_sweep", boom)
+    t1, t2 = svc.submit(wins[0]), svc.submit(wins[1])
+    assert t1.done.wait(30) and t2.done.wait(30)
+    assert isinstance(t1.error, RuntimeError)
+    assert isinstance(t2.error, RuntimeError)
+
+    # the daemon survives: with the fault cleared, the next wave serves
+    monkeypatch.undo()
+    t3 = svc.submit(wins[0])
+    assert t3.done.wait(30)
+    assert t3.error is None
+    f_want, r_want = voting.run_sweep(wins[0])
+    np.testing.assert_array_equal(t3.result[0], f_want)
+    np.testing.assert_array_equal(t3.result[1], r_want)
+
+
 @pytest.mark.parametrize("graph", ["consensus", "funky_full"])
 def test_accel_with_batcher_matches_oracle(graph):
     from tests.test_accel import (
