@@ -1,0 +1,162 @@
+"""Unit tests for the device-resolution module (babble_tpu/ops/device.py)
+— the layer every perf claim and every wedge-degradation path routes
+through. The probe subprocess itself is exercised with a stub
+interpreter command via monkeypatching subprocess.run, so these tests
+never touch a real backend.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from babble_tpu.ops import device
+
+
+@pytest.fixture
+def fresh(monkeypatch):
+    """Reset the module's resolution state around each test — and restore
+    it afterwards even when the CODE UNDER TEST mutates it (ensure_device
+    writes _resolved and exports BABBLE_DEVICE_RESOLVED; monkeypatch only
+    reverts its own changes, so without explicit restore a DEAD result
+    here would poison every later accelerator test in the process)."""
+    import os
+
+    prev_resolved = device._resolved
+    prev_env = os.environ.get("BABBLE_DEVICE_RESOLVED")
+    device._resolved = None
+    os.environ.pop("BABBLE_DEVICE_RESOLVED", None)
+    monkeypatch.delenv("BABBLE_DEVICE_PROBE_RETRIES", raising=False)
+    monkeypatch.delenv("BABBLE_DEVICE_PROBE_BACKOFF", raising=False)
+    yield
+    device._resolved = prev_resolved
+    if prev_env is None:
+        os.environ.pop("BABBLE_DEVICE_RESOLVED", None)
+    else:
+        os.environ["BABBLE_DEVICE_RESOLVED"] = prev_env
+
+
+class _Fake:
+    def __init__(self, platform, kind, s):
+        self.platform = platform
+        self.device_kind = kind
+        self._s = s
+
+    def __str__(self):
+        return self._s
+
+
+def test_is_tpu_device_classifier():
+    assert device._is_tpu_device(_Fake("axon", "TPU v5 lite", "TPU v5 lite0"))
+    assert device._is_tpu_device(_Fake("tpu", "", "dev0"))
+    assert device._is_tpu_device(_Fake("cpu", "TPU-ish", "x"))  # kind wins
+    assert not device._is_tpu_device(_Fake("cpu", "cpu", "TFRT_CPU_0"))
+
+
+def test_describe_dead_never_imports_jax(monkeypatch):
+    monkeypatch.setattr(device, "_resolved", device.DEAD)
+    d = device.describe()
+    assert d == {"resolved": "dead", "device": None, "capture_class": "dead"}
+    assert not device.jax_usable()
+
+
+def test_handoff_dead_child_never_probes(fresh, monkeypatch):
+    """A child of a DEAD-resolved parent must not probe (it would hang):
+    the env handoff is authoritative."""
+    monkeypatch.setenv("BABBLE_DEVICE_RESOLVED", device.DEAD)
+
+    def boom(*a, **k):
+        raise AssertionError("child ran a probe despite the DEAD handoff")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert device.ensure_device() == device.DEAD
+    assert not device.jax_usable()
+
+
+def test_probe_timeout_marks_dead(fresh, monkeypatch):
+    """A hung probe (subprocess timeout) with jax not yet imported marks
+    the device DEAD so nothing in-process ever imports jax."""
+    import sys
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    calls = {"n": 0}
+
+    def hang(*a, **k):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    # jax IS imported in the test process; the DEAD branch requires it
+    # absent, so simulate that by hiding it from the module's check
+    monkeypatch.setattr(device, "sys", type(sys)("fake_sys"))
+    device.sys.modules = {}
+    device.sys.executable = sys.executable
+    out = device.ensure_device(timeout_s=1)
+    assert out == device.DEAD
+    assert calls["n"] == 1  # default: no retries
+    assert not device.jax_usable()
+
+
+def test_probe_retries_honor_budget_for_timeouts(fresh, monkeypatch):
+    """Timeouts (wedged tunnel) consume the whole retry budget..."""
+    import sys
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    monkeypatch.setenv("BABBLE_DEVICE_PROBE_RETRIES", "3")
+    monkeypatch.setenv("BABBLE_DEVICE_PROBE_BACKOFF", "0")
+    calls = {"n": 0}
+
+    def hang(*a, **k):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    monkeypatch.setattr(device, "sys", type(sys)("fake_sys"))
+    device.sys.modules = {}
+    device.sys.executable = sys.executable
+    assert device.ensure_device(timeout_s=1) == device.DEAD
+    assert calls["n"] == 4  # 1 + 3 retries
+
+
+def test_probe_fast_failures_capped_at_two(fresh, monkeypatch):
+    """...but deterministic fast failures (platform not installed) stop
+    after two attempts instead of burning the full backoff budget."""
+    import sys
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    monkeypatch.setenv("BABBLE_DEVICE_PROBE_RETRIES", "5")
+    monkeypatch.setenv("BABBLE_DEVICE_PROBE_BACKOFF", "0")
+    # hide the already-imported jax so the probe path runs (the real
+    # jax.config would otherwise shortcut to the pinned cpu platform)
+    monkeypatch.setattr(device, "sys", type(sys)("fake_sys"))
+    device.sys.modules = {}
+    device.sys.executable = sys.executable
+    calls = {"n": 0}
+
+    class _Ret:
+        returncode = 1
+
+    def fail_fast(*a, **k):
+        calls["n"] += 1
+        return _Ret()
+
+    monkeypatch.setattr(subprocess, "run", fail_fast)
+    out = device.ensure_device(timeout_s=1)
+    assert out == "cpu"  # fell back to host XLA (jax already importable)
+    assert calls["n"] == 2
+    assert device.jax_usable()
+
+
+def test_successful_probe_resolves_and_exports(fresh, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    import os
+
+    # jax is already imported under the test conftest with platform cpu,
+    # so the shortcut path resolves without any probe
+    out = device.ensure_device(timeout_s=1)
+    assert out.startswith("cpu")
+    assert os.environ["BABBLE_DEVICE_RESOLVED"] == out
+    d = device.describe()
+    assert d["capture_class"] == "cpu-xla"
+    assert d["device"]
