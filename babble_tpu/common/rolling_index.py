@@ -5,6 +5,16 @@ consecutive integer indexes; when the buffer holds `size` items, the next
 append first evicts the oldest half (keeping items[size//2:]). Reads below
 the retained window raise TOO_LATE; reads beyond the head raise
 KEY_NOT_FOUND; non-sequential appends raise SKIPPED_INDEX.
+
+Concurrency: writes are serialized by the owner (the node's core-lock
+discipline), but the batched-ingest fast path READS participant indexes
+without that lock (Core.prepare_sync). Readers therefore resolve against
+``_window`` — an immutable (items, last_index, count) tuple the writer
+publishes atomically after every append — so a read can never mix a new
+``_last_index`` with an old item list (the torn read resolved the WRONG
+parent for an in-flight decode). The tuple's ``count`` pins the mapping
+even while the shared list grows underneath it; ``_roll`` swaps in a new
+list, leaving published snapshots self-consistent.
 """
 
 from __future__ import annotations
@@ -20,31 +30,47 @@ class RollingIndex:
         self.size = size
         self._items: List[Any] = []
         self._last_index = -1
+        # (items, last_index, count) — atomically replaced on append
+        self._window: tuple = (self._items, -1, 0)
 
     def get_last_window(self) -> tuple[list[Any], int]:
-        return self._items, self._last_index
+        items, last, n = self._window
+        return items[:n], last
+
+    def last_index(self) -> int:
+        """Head index without copying the window (known-events maps read
+        this per participant per gossip round)."""
+        return self._window[1]
+
+    def last_item(self) -> Any:
+        """Newest item, or None when empty — again without the copy."""
+        items, _, n = self._window
+        return items[n - 1] if n else None
 
     def get(self, skip_index: int) -> list[Any]:
         """Return items with index > skip_index (reference: rolling_index.go:33-55)."""
-        if skip_index > self._last_index:
+        items, last, n = self._window
+        if skip_index > last:
             return []
-        cached_start = self._last_index - len(self._items) + 1
+        cached_start = last - n + 1
         if skip_index + 1 < cached_start:
             raise StoreError(self.name, StoreErrorKind.TOO_LATE, str(skip_index))
         start = skip_index + 1 - cached_start
-        return self._items[start:]
+        return items[start:n]
 
     def get_item(self, index: int) -> Any:
-        n = len(self._items)
-        cached_start = self._last_index - n + 1
+        items, last, n = self._window
+        cached_start = last - n + 1
         if index < cached_start:
             raise StoreError(self.name, StoreErrorKind.TOO_LATE, str(index))
-        if index > self._last_index:
+        if index > last:
             raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(index))
-        return self._items[index - cached_start]
+        return items[index - cached_start]
 
     def set(self, item: Any, index: int) -> None:
-        # Updating a stored item in place is allowed (reference: rolling_index.go:78-84).
+        # Updating a stored item in place is allowed (reference:
+        # rolling_index.go:78-84); the mapping is unchanged, so published
+        # snapshots stay valid.
         if self._items and index <= self._last_index:
             cached_start = self._last_index - len(self._items) + 1
             if index < cached_start:
@@ -57,7 +83,10 @@ class RollingIndex:
             self._roll()
         self._items.append(item)
         self._last_index = index
+        self._window = (self._items, index, len(self._items))
 
     def _roll(self) -> None:
         # Evict the earlier half, keeping items[size//2:] (rolling_index.go:105-109).
+        # A NEW list: snapshots published before the roll keep indexing
+        # the old one consistently.
         self._items = self._items[self.size // 2 :]

@@ -40,10 +40,10 @@ class RollingIndexMap:
     def get_last(self, key: int) -> Any:
         if key not in self.mapping:
             raise StoreError(self.name, StoreErrorKind.KEY_NOT_FOUND, str(key))
-        last, _ = self.mapping[key].get_last_window()
-        if not last:
+        item = self.mapping[key].last_item()
+        if item is None:
             raise StoreError(self.name, StoreErrorKind.EMPTY, str(key))
-        return last[-1]
+        return item
 
     def set(self, key: int, item: Any, index: int) -> None:
         if key not in self.mapping:
@@ -51,5 +51,8 @@ class RollingIndexMap:
         self.mapping[key].set(item, index)
 
     def known(self) -> dict[int, int]:
-        """Map key → last known index (reference: rolling_index_map.go:85-97)."""
-        return {k: ri.get_last_window()[1] for k, ri in self.mapping.items()}
+        """Map key → last known index (reference: rolling_index_map.go:85-97).
+        Reads only the head index — copying each participant's whole
+        window here would put O(cache_size) allocations inside the very
+        critical section the ingest fast path shrinks."""
+        return {k: ri.last_index() for k, ri in self.mapping.items()}

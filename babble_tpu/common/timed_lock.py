@@ -1,0 +1,61 @@
+"""TimedLock — a threading.Lock that accounts its acquisition wait time.
+
+The node serializes all hashgraph access on one core lock (the reference's
+coreLock discipline, node.go:35); the round-5 profile put ~70% of
+co-located samples inside ``lock.acquire``. Shrinking those critical
+sections is only credible if the wait is *measured*, so the node's core
+lock is this instrumented wrapper and ``get_stats`` surfaces
+``lock_wait_ms_total`` / ``lock_acquisitions`` from it.
+
+Accounting is monotonic-clock wall time summed across every acquiring
+thread; under the GIL the float += races are benign for a stats counter
+(worst case an update is lost, never corrupted).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TimedLock:
+    """Drop-in ``threading.Lock`` replacement that records total time
+    spent *waiting* to acquire (contention, not hold time)."""
+
+    __slots__ = ("_lock", "wait_s_total", "acquisitions")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.wait_s_total: float = 0.0
+        self.acquisitions: int = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # Fast path: an uncontended acquire skips the two clock reads —
+        # this wrapper must not tax the very path it instruments.
+        if self._lock.acquire(False):
+            self.acquisitions += 1
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._lock.acquire(True, timeout)
+        self.wait_s_total += time.perf_counter() - t0
+        if ok:
+            self.acquisitions += 1
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TimedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait_ms_total(self) -> float:
+        return 1e3 * self.wait_s_total

@@ -14,6 +14,26 @@ import json
 from typing import Any
 
 
+class CacheStats:
+    """Hit/miss tally for a serialization memo. Process-wide (co-located
+    nodes share it); increments race benignly under the GIL — a stats
+    counter may drop an update, never corrupt."""
+
+    __slots__ = ("hits", "misses")
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+
+    def snapshot(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses}
+
+
+#: memo_normalized() effectiveness — how often an event body / wire event
+#: re-serialization was avoided (gossip replies, frame re-encodes).
+NORM_CACHE = CacheStats()
+
+
 class PreNormalized:
     """Wrapper marking a value as ALREADY normalized (b64 applied, plain
     str/int/dict/list all the way down). _normalize passes it through
@@ -33,8 +53,11 @@ def memo_normalized(holder: Any, build) -> Any:
     underlying object mutates."""
     n = getattr(holder, "_norm", None)
     if n is None:
+        NORM_CACHE.misses += 1
         n = _normalize(build())
         holder._norm = n
+    else:
+        NORM_CACHE.hits += 1
     return n
 
 

@@ -52,20 +52,31 @@ class BlockBody:
 
     def __setattr__(self, name, value):
         # Any body mutation (commit fills state_hash/receipts) invalidates
-        # the cached canonical hash.
+        # the cached canonical hash — by bumping a version, not clearing a
+        # flag: a concurrent hash() writing its result AFTER this
+        # invalidation must not resurrect the pre-mutation digest (the
+        # lost-invalidation race a reader thread hits while commit fills
+        # the body).
         object.__setattr__(self, name, value)
-        if name != "_hash_cache":
-            object.__setattr__(self, "_hash_cache", b"")
+        if name not in ("_hash_cache", "_hash_version"):
+            object.__setattr__(
+                self, "_hash_version", getattr(self, "_hash_version", 0) + 1
+            )
 
     def hash(self) -> bytes:
         """SHA256 of the canonical encoding — what validators sign
         (reference: block.go:49-55). Cached until a field changes: the sig
-        pool re-verifies against this hash once per gossiped signature."""
-        cached = getattr(self, "_hash_cache", b"")
-        if not cached:
-            cached = sha256(canonical_dumps(self.to_dict()))
-            object.__setattr__(self, "_hash_cache", cached)
-        return cached
+        pool re-verifies against this hash once per gossiped signature.
+        The cache entry is (version, digest); a digest computed against a
+        body that mutated mid-walk carries a stale version and is simply
+        recomputed on the next call."""
+        ver = getattr(self, "_hash_version", 0)
+        cached = getattr(self, "_hash_cache", None)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        digest = sha256(canonical_dumps(self.to_dict()))
+        object.__setattr__(self, "_hash_cache", (ver, digest))
+        return digest
 
     @staticmethod
     def from_dict(d: dict) -> "BlockBody":

@@ -19,10 +19,16 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.crypto.canonical import CacheStats, canonical_dumps
 from babble_tpu.crypto.hashing import sha256
 from babble_tpu.crypto.keys import PrivateKey, PublicKey, decode_signature
 from babble_tpu.hashgraph.internal_transaction import InternalTransaction
+
+
+#: Event.to_wire() memo effectiveness: a hit means a gossip push/reply
+#: reused the cached WireEvent instead of rebuilding it (process-wide;
+#: surfaced per node via get_stats as wire_cache_hits/misses).
+WIRE_CACHE = CacheStats()
 
 
 def encode_hash(hash_bytes: bytes) -> str:
@@ -315,6 +321,15 @@ class Event:
         """Cache a signature verdict computed out-of-band (batch path)."""
         self._sig_ok = bool(ok)
 
+    def prevalidated(self) -> Optional[bool]:
+        """The cached batch verdict, or None if never batch-verified."""
+        return self._sig_ok
+
+    def clear_prevalidation(self) -> None:
+        """Drop the cached verdict so verify() re-runs the scalar path —
+        the batch-failure fallback uses this to pinpoint offenders."""
+        self._sig_ok = None
+
     # -- consensus annotations --------------------------------------------
 
     def set_round(self, r: int) -> None:
@@ -352,21 +367,24 @@ class Event:
         shared WireEvent also memoizes its normalized (base64-applied)
         encoding, so per-transaction b64 work happens once per event
         instead of once per send (set_wire_info invalidates)."""
-        if self._wire is None:
-            self._wire = WireEvent(
-                body=WireBody(
-                    transactions=list(self.body.transactions),
-                    internal_transactions=list(self.body.internal_transactions),
-                    block_signatures=self.wire_block_signatures(),
-                    creator_id=self.body.creator_id,
-                    other_parent_creator_id=self.body.other_parent_creator_id,
-                    index=self.body.index,
-                    self_parent_index=self.body.self_parent_index,
-                    other_parent_index=self.body.other_parent_index,
-                    timestamp=self.body.timestamp,
-                ),
-                signature=self.signature,
-            )
+        if self._wire is not None:
+            WIRE_CACHE.hits += 1
+            return self._wire
+        WIRE_CACHE.misses += 1
+        self._wire = WireEvent(
+            body=WireBody(
+                transactions=list(self.body.transactions),
+                internal_transactions=list(self.body.internal_transactions),
+                block_signatures=self.wire_block_signatures(),
+                creator_id=self.body.creator_id,
+                other_parent_creator_id=self.body.other_parent_creator_id,
+                index=self.body.index,
+                self_parent_index=self.body.self_parent_index,
+                other_parent_index=self.body.other_parent_index,
+                timestamp=self.body.timestamp,
+            ),
+            signature=self.signature,
+        )
         return self._wire
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid

@@ -784,15 +784,26 @@ class Hashgraph:
 
                     block = Block.from_frame(self.store.last_block_index() + 1, frame)
                     if block.transactions() or block.internal_transactions():
-                        self.store.set_block(block)
+                        # Commit BEFORE publishing via set_block: the
+                        # callback mutates the body (state_hash, receipts)
+                        # and signs it, and set_block is what advances
+                        # last_block_index — publishing first let
+                        # concurrent readers hash a half-committed body
+                        # and (via the lost-invalidation cache race) left
+                        # a stale digest that this node then SIGNED
+                        # (surfaced by test_bootstrap_recycle_reproduces_
+                        # chain once the batched-ingest path sped gossip
+                        # up). The callback's own sign path re-persists
+                        # the block; this set_block also covers the
+                        # commit-failure case, keeping the reference's
+                        # non-fatal semantics (hashgraph.go:1162-1165).
                         try:
                             self.commit_callback(block)
                         except Exception:
-                            # Commit failures are non-fatal (the reference
-                            # logs a warning and carries on, hashgraph.go:1162-1165).
                             logger.warning(
                                 "failed to commit block %d", block.index(), exc_info=True
                             )
+                        self.store.set_block(block)
                     self.last_committed_round_events = len(frame.events)
 
                 processed_rounds.append(pr.index)

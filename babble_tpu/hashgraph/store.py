@@ -144,8 +144,9 @@ class InmemStore:
         return self._participant_events_cache.known()
 
     def consensus_events(self) -> List[str]:
+        # get_last_window already returns a fresh copy
         window, _ = self._consensus_cache.get_last_window()
-        return list(window)
+        return window
 
     def consensus_events_count(self) -> int:
         return self._tot_consensus_events

@@ -64,6 +64,11 @@ class SweepBatcher:
     #: decision latency (the pipelined mode hides it behind gossip anyway).
     COALESCE_S = 0.004
     MAX_BATCH = 16
+    #: consecutive waves strictly below the target bucket before it decays
+    #: back toward the observed per-wave max — one oversized window (a
+    #: rejoin backlog, a churn spike) must not permanently inflate the
+    #: padded shapes every later batch pays to compute.
+    DECAY_WAVES = 24
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -78,6 +83,9 @@ class SweepBatcher:
         # compile kicks in one 20 s run, zero warm batches).
         self.floor_key: Optional[tuple] = None
         self._target: Optional[tuple] = None
+        # decay bookkeeping (see _update_target)
+        self._below_waves = 0
+        self._decay_max: Optional[tuple] = None
         # stats
         self.batches = 0  # batched dispatches (>= 2 windows)
         self.singles = 0  # lone or unwarmed windows dispatched singly
@@ -85,6 +93,7 @@ class SweepBatcher:
         self.max_batch_seen = 0
         self.compile_kicks = 0
         self.refused = 0  # submissions bounced by backpressure
+        self.target_decays = 0  # times the monotone bucket shrank back
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="sweep-batcher"
         )
@@ -122,6 +131,7 @@ class SweepBatcher:
             "batch_max": self.max_batch_seen,
             "batch_compile_kicks": self.compile_kicks,
             "batch_refused": self.refused,
+            "batch_target_decays": self.target_decays,
         }
 
     # -- dispatcher ----------------------------------------------------------
@@ -165,10 +175,8 @@ class SweepBatcher:
         keys = [voting.bucket_key(t.win) for t in group]
         if self.floor_key is not None:
             keys.append(self.floor_key)
-        if self._target is not None:
-            keys.append(self._target)
-        target = tuple(max(k[d] for k in keys) for d in range(5))
-        self._target = target
+        wave = tuple(max(k[d] for k in keys) for d in range(5))
+        target = self._update_target(wave)
         B = self.MAX_BATCH
         if len(group) > 1 and voting.batched_ready(target, B):
             padded = [voting.repad_window(t.win, target) for t in group]
@@ -218,6 +226,39 @@ class SweepBatcher:
             self.singles += 1
             self.windows += 1
             t.done.set()
+
+    def _update_target(self, wave: tuple) -> tuple:
+        """Monotone-with-decay shape bucket. The target grows to cover
+        every wave (keeping dispatches on one warm program), but after
+        DECAY_WAVES consecutive waves strictly below it, it shrinks back
+        to the elementwise max actually observed in that window — so one
+        oversized window stops permanently inflating padded shapes. The
+        floor_key rides inside ``wave`` (the caller folds it in), so
+        decay never drops below the prewarmed floor."""
+        t = self._target
+        if t is None:
+            self._target = wave
+            return wave
+        grown = tuple(max(w, d) for w, d in zip(wave, t))
+        if grown != t or wave == t:
+            # at or above the target in some dimension: (re)grow and
+            # restart the decay observation window
+            self._target = grown
+            self._below_waves = 0
+            self._decay_max = None
+            return grown
+        # strictly below the target in >= 1 dim, nowhere above
+        dm = self._decay_max
+        self._decay_max = (
+            wave if dm is None else tuple(max(a, b) for a, b in zip(dm, wave))
+        )
+        self._below_waves += 1
+        if self._below_waves >= self.DECAY_WAVES:
+            self._target = self._decay_max
+            self.target_decays += 1
+            self._below_waves = 0
+            self._decay_max = None
+        return self._target
 
     def _kick_compile(self, key: tuple, batch: int) -> None:
         gate = (batch, key)

@@ -53,7 +53,7 @@ def _build_at(so_path: str) -> bool:
     try:
         os.makedirs(os.path.dirname(so_path), exist_ok=True)
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=60,

@@ -362,6 +362,8 @@ class SignalTransport:
         self._direct: Dict[str, _DirectLink] = {}  # peer pub -> link
         self._dlock = threading.Lock()
         self._offered: set = set()  # peers we already offered to
+        self._dialing: set = set()  # peers with a dial in flight
+        self._fallback_waiting: set = set()  # larger-side grace timers
 
     # -- Transport interface -------------------------------------------------
 
@@ -548,6 +550,65 @@ class SignalTransport:
             return
         self._adopt_link(_DirectLink(conn, peer))
 
+    def _should_dial(self, peer: str) -> bool:
+        """Deterministic cross-dial tie-break: of any pair, only the
+        lexicographically SMALLER pubkey dials; the larger side answers
+        with its endpoint (so the smaller learns where to dial) and waits
+        for the inbound handshake. Without this both sides dial on a
+        simultaneous offer/answer exchange, each end adopts a DIFFERENT
+        crossing socket, and latest-wins replacement can close the link
+        the other side is still using (~1/3 flake in
+        test_rpc_upgrades_to_direct_link). Both strings are _norm()ed
+        lowercase hex, so the comparison agrees on both ends."""
+        return self._pub < peer
+
+    def _dial_direct(self, peer: str, addr: str) -> None:
+        """_direct_connect with the in-flight bookkeeping the offer
+        handler uses to avoid concurrent duplicate dials to one peer."""
+        try:
+            self._direct_connect(peer, addr)
+        finally:
+            with self._dlock:
+                self._dialing.discard(peer)
+
+    #: how long the larger pubkey waits for the deterministic (smaller)
+    #: dialer before trying the reverse direction itself. This must sit
+    #: well above worst-case handshake latency — on a loaded single-core
+    #: host a HEALTHY smaller-side dial can stall for seconds (GIL
+    #: starvation), and a premature fallback resurrects exactly the
+    #: crossing-socket churn the tie-break removed. One-sided
+    #: reachability recovery is an escape hatch, not a hot path; paying
+    #: ten seconds once per affected pair is fine.
+    FALLBACK_DIAL_GRACE_S = 10.0
+
+    def _fallback_dial(self, peer: str, addr: str) -> None:
+        """One-sided-reachability escape hatch for the non-dialing
+        (larger) side: if no link materializes within the grace window —
+        i.e. the smaller peer's deterministic dial is failing, e.g.
+        against our NAT'd endpoint — dial the peer's advertised address
+        ourselves. Crossing sockets are only possible when the smaller
+        dial is genuinely slow/failing, and latest-wins adoption resolves
+        that rare overlap."""
+        deadline = time.monotonic() + self.FALLBACK_DIAL_GRACE_S
+        try:
+            while time.monotonic() < deadline:
+                if self._shutdown.is_set():
+                    return
+                with self._dlock:
+                    if peer in self._direct:
+                        return
+                time.sleep(0.1)
+            if self._shutdown.is_set():
+                return
+            with self._dlock:
+                if peer in self._direct or peer in self._dialing:
+                    return
+                self._dialing.add(peer)
+            self._dial_direct(peer, addr)
+        finally:
+            with self._dlock:
+                self._fallback_waiting.discard(peer)
+
     def _rearm_offer(self, peer: str) -> None:
         """A failed connect must not leave ``peer`` stuck in the offered
         set: with no link AND no pending offer the pair could never
@@ -562,6 +623,12 @@ class SignalTransport:
         would let the stale registered link shadow the fresh one forever;
         replacing closes the old link (any reply in flight on it fails
         and the requester retries via the relay)."""
+        if self._shutdown.is_set():
+            # a dial (e.g. the larger side's grace-period fallback) can
+            # complete its handshake just as close() sweeps _direct;
+            # adopting now would leak the socket + a blocked reader
+            link.close()
+            return
         with self._dlock:
             old = self._direct.get(link.peer)
             self._direct[link.peer] = link
@@ -663,23 +730,61 @@ class SignalTransport:
                         ).start()
                     elif kind == "direct":
                         # relay-signaled endpoint exchange (SDP-offer
-                        # analogue): try a direct connection, and answer
-                        # with our own endpoint so the peer can try too
-                        # (covers one-sided reachability). Answers are
-                        # not re-answered — no offer loops. Nodes WITHOUT
-                        # direct_listen ignore offers entirely: "empty =
-                        # gossip stays relayed" is an operator promise
-                        # (egress policy), and honoring a peer's offer
-                        # would let any registered key make this node dial
-                        # an arbitrary address.
+                        # analogue): the lexicographically smaller pubkey
+                        # dials (deterministic tie-break — see
+                        # _should_dial); the larger side answers with its
+                        # own endpoint so the smaller learns where to
+                        # dial, and arms a grace-period fallback dial for
+                        # one-sided reachability (_fallback_dial).
+                        # Answers are not re-answered — no offer loops.
+                        # Nodes WITHOUT direct_listen ignore offers
+                        # entirely: "empty = gossip stays relayed" is an
+                        # operator promise (egress policy), and honoring
+                        # a peer's offer would let any registered key
+                        # make this node dial an arbitrary address.
                         peer = self._norm(frame.get("from") or "")
                         addr = frame.get("addr")
                         if self._direct_listen and peer and addr:
+                            is_answer = bool(frame.get("answer"))
+                            dial = fallback = False
                             with self._dlock:
                                 have = peer in self._direct
-                            if not have:
+                                dialing = peer in self._dialing
+                                if self._should_dial(peer):
+                                    # An OFFER means the peer has no
+                                    # usable link to us (it only offers
+                                    # when unlinked): a link registered
+                                    # here is stale-or-dying knowledge,
+                                    # so the dialer side redials and
+                                    # latest-wins replaces it. Answers
+                                    # only follow our own offer (no link
+                                    # on our side at offer time).
+                                    dial = not dialing and (
+                                        not have or not is_answer
+                                    )
+                                    if dial:
+                                        self._dialing.add(peer)
+                                elif (
+                                    not have
+                                    and peer not in self._fallback_waiting
+                                ):
+                                    # Larger side: normally only answers,
+                                    # but arms a grace-period reverse dial
+                                    # for one-sided reachability (the
+                                    # smaller peer's dial may target an
+                                    # unreachable NAT'd endpoint while
+                                    # ours would succeed).
+                                    fallback = True
+                                    self._fallback_waiting.add(peer)
+                            if dial:
                                 threading.Thread(
-                                    target=self._direct_connect,
+                                    target=self._dial_direct,
+                                    args=(peer, addr),
+                                    daemon=True,
+                                ).start()
+                            elif fallback:
+                                threading.Thread(
+                                    target=self._fallback_dial,
                                     args=(peer, addr),
                                     daemon=True,
                                 ).start()

@@ -37,6 +37,23 @@ logger = logging.getLogger(__name__)
 PEER_SET_EFFECTIVE_DELAY = 6
 
 
+class PreparedSync:
+    """Lock-free ingest work for one incoming sync: the longest decodable
+    prefix of the wire events, hashed and batch-signature-verified OUTSIDE
+    the core lock. ``Core.sync`` consumes it under the lock, which then
+    only pays for the ordered insert + DivideRounds sweep.
+
+    Contract: must be built (``Core.prepare_sync``) from the SAME wire
+    event list later passed to ``Core.sync`` — ``decoded[i]`` corresponds
+    to ``wire_events[i]``."""
+
+    __slots__ = ("wire_events", "decoded")
+
+    def __init__(self, wire_events: List[WireEvent]):
+        self.wire_events = wire_events
+        self.decoded: List[Event] = []
+
+
 class Core:
     """reference: core.go:19-100."""
 
@@ -83,6 +100,16 @@ class Core:
         self.internal_transaction_pool: List[InternalTransaction] = []
         self.self_block_signatures = {}  # key -> BlockSignature
         self.promises: Dict[str, JoinPromise] = {}
+
+        # Batched-ingest fast-path counters (surfaced via Node.get_stats
+        # and bench.py): on the happy path every incoming sync costs
+        # exactly ONE native batch-verify call, and fallback_singles
+        # counts the per-event scalar re-checks that pinpoint offenders
+        # after a batch reported failures.
+        self.ingest_syncs = 0
+        self.ingest_batch_verifies = 0
+        self.ingest_batch_size_max = 0
+        self.ingest_fallback_singles = 0
 
         self.hg = Hashgraph(store, self.commit)
         self.hg.init(genesis_peers)
@@ -144,69 +171,141 @@ class Core:
 
     # -- sync ---------------------------------------------------------------
 
-    def sync(self, from_id: int, unknown_events: List[WireEvent]) -> None:
+    def prepare_sync(self, unknown_events: List[WireEvent]) -> PreparedSync:
+        """Lock-free ingest stage: decode + hash the longest possible
+        prefix of an incoming sync and verify all its signatures in ONE
+        native batch call. Callers (node gossip/eager-sync handlers) run
+        this BEFORE taking the core lock, so the lock only serializes the
+        ordered insert + DivideRounds sweep.
+
+        Thread-safety: the store is append-only for events (an index,
+        once assigned, never re-resolves to a different hash), so the
+        parent resolution in read_wire_info is snapshot-safe against
+        concurrent inserts; the overlay covers parents that ride in the
+        same sync. A decode stall (parent/creator only resolvable after
+        inserting earlier events, e.g. a mid-batch membership change)
+        cuts the prefix — Core.sync re-decodes the tail under the lock
+        with the same chunked semantics as the reference's sequential
+        decode+insert (core.go:210-289)."""
+        prepared = PreparedSync(unknown_events)
+        if not (self.accelerated_verify or self._host_batch_verify):
+            # Sequential scalar path: decode and verify under the lock,
+            # exactly the reference shape.
+            return prepared
+        decoded, _ = self._decode_chunk(unknown_events, 0)
+        if decoded:
+            self._batch_prevalidate(decoded)
+        prepared.decoded = decoded
+        return prepared
+
+    def _decode_chunk(
+        self, unknown_events: List[WireEvent], start: int
+    ) -> tuple[List[Event], int]:
+        """Decode the longest decodable run of ``unknown_events[start:]``,
+        resolving same-sync parents through an overlay of the events
+        decoded so far. Returns (decoded, next_pos); a decode stall cuts
+        the run at next_pos. Shared by the lock-free prepare stage and
+        sync's under-lock tail so their semantics can never diverge."""
+        overlay: Dict[tuple, str] = {}
+        decoded: List[Event] = []
+        j = start
+        n = len(unknown_events)
+        while j < n:
+            try:
+                ev = self.hg.read_wire_info(unknown_events[j], overlay)
+            except Exception:
+                break
+            overlay[(ev.creator(), ev.index())] = ev.hex()
+            decoded.append(ev)
+            j += 1
+        return decoded, j
+
+    def _batch_prevalidate(self, decoded: List[Event]) -> None:
+        """Verify a decoded chunk's signatures in one batch call, then
+        pinpoint offenders: events the batch flagged are re-checked
+        through the scalar verifier one by one, so a batch-layer artifact
+        can never reject a valid event and a genuinely bad event is
+        identified exactly (its verdict stays cached for insert to
+        reject)."""
+        use_device_verify = self.accelerated_verify
+        if use_device_verify:
+            # Measured on the target: the device ladder kernel costs
+            # ~590 ms per 64-signature tile through the accelerator
+            # tunnel (dispatch/loop-bound) vs ~100 us/sig for the native
+            # C++ verifier — the device NEVER wins at gossip batch sizes,
+            # so the sync path stays on the host unless explicitly forced
+            # (benchmarking / future hardware).
+            import os
+
+            from babble_tpu.ops.device import is_cpu_fallback, jax_usable
+
+            # Opt-in AND a live accelerator: on the CPU/DEAD fallbacks
+            # the ladder kernel would run on host XLA (or hang importing
+            # jax), losing badly to the native verifier below.
+            use_device_verify = (
+                os.environ.get("BABBLE_DEVICE_VERIFY") == "1"
+                and jax_usable()
+                and not is_cpu_fallback()
+            )
+        if use_device_verify:
+            from babble_tpu.ops.verify import prevalidate_events
+
+            prevalidate_events(decoded)
+        else:
+            from babble_tpu.crypto.batch import prevalidate_events_host
+
+            if not prevalidate_events_host(decoded):
+                # Native library unavailable: scalar verify at insert.
+                return
+        self.ingest_batch_verifies += 1
+        if len(decoded) > self.ingest_batch_size_max:
+            self.ingest_batch_size_max = len(decoded)
+        for ev in decoded:
+            if ev.prevalidated() is False:
+                ev.clear_prevalidation()
+                ev.prevalidate(ev.verify())
+                self.ingest_fallback_singles += 1
+
+    def sync(
+        self,
+        from_id: int,
+        unknown_events: List[WireEvent],
+        prepared: Optional[PreparedSync] = None,
+    ) -> None:
         """Insert wire events (topological order expected), track the other
         peer's head, and record a new self-event when busy
-        (reference: core.go:210-289)."""
+        (reference: core.go:210-289).
+
+        ``prepared`` is the lock-free stage's output for these SAME wire
+        events (see prepare_sync); without it the stage runs inline here,
+        preserving the one-batch-verify-per-sync property for direct
+        callers."""
+        self.ingest_syncs += 1
+        if prepared is None:
+            prepared = self.prepare_sync(unknown_events)
+        elif prepared.wire_events is not unknown_events:
+            # decoded[i] pairs positionally with wire_events[i]; a
+            # prepared stage built from a different list would silently
+            # mis-pair verified events with wire bookkeeping
+            raise ValueError("prepared sync does not match wire events")
         other_head: Optional[Event] = None
-        pos = 0
         n = len(unknown_events)
+
+        pos = len(prepared.decoded)
+        for we, ev in zip(unknown_events[:pos], prepared.decoded):
+            other_head = self._ingest_one(we, ev, from_id, other_head)
+
         while pos < n:
-            # Decode the longest possible prefix ahead of insertion so its
-            # signatures can be verified in one accelerator batch. A decode
-            # stall (parent/creator only resolvable after inserting earlier
-            # events, e.g. a mid-batch membership change) cuts the chunk;
-            # the loop resumes after those inserts land — identical
-            # semantics to the reference's sequential decode+insert
-            # (core.go:210-289), just batched where the DAG allows.
+            # Tail after a decode stall: re-run decode+batch-verify in
+            # chunks under the lock, resuming after the stalled inserts
+            # land — identical semantics to the reference's sequential
+            # decode+insert, just batched where the DAG allows.
             decoded: List[Event] = []
-            overlay: Dict[tuple, str] = {}
             j = pos
             if self.accelerated_verify or self._host_batch_verify:
-                while j < n:
-                    try:
-                        ev = self.hg.read_wire_info(unknown_events[j], overlay)
-                    except Exception:
-                        break
-                    overlay[(ev.creator(), ev.index())] = ev.hex()
-                    decoded.append(ev)
-                    j += 1
+                decoded, j = self._decode_chunk(unknown_events, pos)
                 if decoded:
-                    use_device_verify = self.accelerated_verify
-                    if use_device_verify:
-                        # Measured on the target: the device ladder kernel
-                        # costs ~590 ms per 64-signature tile through the
-                        # accelerator tunnel (dispatch/loop-bound) vs
-                        # ~100 us/sig for the native C++ verifier — the
-                        # device NEVER wins at gossip batch sizes, so the
-                        # sync path stays on the host unless explicitly
-                        # forced (benchmarking / future hardware).
-                        import os
-
-                        from babble_tpu.ops.device import (
-                            is_cpu_fallback,
-                            jax_usable,
-                        )
-
-                        # Opt-in AND a live accelerator: on the CPU/DEAD
-                        # fallbacks the ladder kernel would run on host
-                        # XLA (or hang importing jax), losing badly to
-                        # the native verifier below.
-                        use_device_verify = (
-                            os.environ.get("BABBLE_DEVICE_VERIFY") == "1"
-                            and jax_usable()
-                            and not is_cpu_fallback()
-                        )
-                    if use_device_verify:
-                        from babble_tpu.ops.verify import prevalidate_events
-
-                        prevalidate_events(decoded)
-                    else:
-                        from babble_tpu.crypto.batch import (
-                            prevalidate_events_host,
-                        )
-
-                        prevalidate_events_host(decoded)
+                    self._batch_prevalidate(decoded)
             if j == pos:
                 # Sequential path (accelerator off, or chunk stalled at the
                 # first event — let read_wire_info raise its real error).
@@ -214,20 +313,7 @@ class Core:
                 j = pos + 1
 
             for we, ev in zip(unknown_events[pos:j], decoded):
-                try:
-                    self.insert_event_and_run_consensus(ev, set_wire_info=False)
-                except Exception as err:
-                    if is_normal_self_parent_error(err):
-                        # Benign concurrent-duplicate-insert race.
-                        continue
-                    raise
-
-                if we.body.creator_id == from_id:
-                    other_head = ev
-
-                stale = self.heads.get(we.body.creator_id)
-                if stale is not None and we.body.index > stale.index():
-                    del self.heads[we.body.creator_id]
+                other_head = self._ingest_one(we, ev, from_id, other_head)
             pos = j
 
         # Do not overwrite a non-empty head with an empty one
@@ -248,6 +334,31 @@ class Core:
         # One batched voting sweep per sync covers every event inserted
         # above (device path; no-op on the oracle path).
         self.hg.flush_consensus()
+
+    def _ingest_one(
+        self,
+        we: WireEvent,
+        ev: Event,
+        from_id: int,
+        other_head: Optional[Event],
+    ) -> Optional[Event]:
+        """Insert one decoded sync event and maintain the heads-merge
+        bookkeeping; returns the updated other-peer head."""
+        try:
+            self.insert_event_and_run_consensus(ev, set_wire_info=False)
+        except Exception as err:
+            if is_normal_self_parent_error(err):
+                # Benign concurrent-duplicate-insert race.
+                return other_head
+            raise
+
+        if we.body.creator_id == from_id:
+            other_head = ev
+
+        stale = self.heads.get(we.body.creator_id)
+        if stale is not None and we.body.index > stale.index():
+            del self.heads[we.body.creator_id]
+        return other_head
 
     def record_heads(self) -> None:
         """reference: core.go:274-289."""

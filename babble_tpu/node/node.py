@@ -40,9 +40,10 @@ from ..net.transport import Transport, TransportError
 from ..peers.peer import Peer
 from ..peers.peer_set import PeerSet
 from ..common.latency import LatencyRecorder
+from ..common.timed_lock import TimedLock
 from ..proxy.proxy import AppProxy
 from .control_timer import ControlTimer
-from .core import Core
+from .core import Core, PreparedSync
 from .state import State, StateManager
 from .validator import Validator
 
@@ -75,7 +76,9 @@ class Node(StateManager):
             accelerated_verify=conf.accelerator,
             accelerator_mesh=conf.accelerator_mesh,
         )
-        self.core_lock = threading.Lock()
+        # Instrumented core lock: get_stats surfaces total acquisition
+        # wait (lock_wait_ms_total) so lock-shrinking work stays measured.
+        self.core_lock = TimedLock()
         self.trans = trans
         self.proxy = proxy
         self.submit_q = proxy.submit_queue()
@@ -292,6 +295,32 @@ class Node(StateManager):
             "state": str(self.get_state()),
             "moniker": self.core.validator.moniker,
         }
+        # Batched-ingest fast-path counters (ISSUE-1 pipeline): one batch
+        # verify per sync on the happy path, fallback singles pinpoint
+        # offenders, lock_wait measures residual core-lock contention,
+        # and the serialization-cache counters are process-wide (shared
+        # by co-located nodes).
+        from ..crypto.canonical import NORM_CACHE
+        from ..hashgraph.event import WIRE_CACHE
+
+        stats.update(
+            {
+                "ingest_syncs": str(self.core.ingest_syncs),
+                "ingest_batch_verifies": str(self.core.ingest_batch_verifies),
+                "ingest_batch_size_max": str(self.core.ingest_batch_size_max),
+                "ingest_fallback_singles": str(
+                    self.core.ingest_fallback_singles
+                ),
+                "lock_wait_ms_total": str(
+                    round(self.core_lock.wait_ms_total(), 1)
+                ),
+                "lock_acquisitions": str(self.core_lock.acquisitions),
+                "wire_cache_hits": str(WIRE_CACHE.hits),
+                "wire_cache_misses": str(WIRE_CACHE.misses),
+                "norm_cache_hits": str(NORM_CACHE.hits),
+                "norm_cache_misses": str(NORM_CACHE.misses),
+            }
+        )
         accel = self.core.hg.accel
         if accel is not None:
             stats.update({k: str(v) for k, v in accel.stats().items()})
@@ -324,10 +353,14 @@ class Node(StateManager):
                 self._reset_timer()
 
     def _reset_timer(self) -> None:
-        """reference: node.go:365-379."""
+        """reference: node.go:365-379.
+
+        busy() is a snapshot read of plain attributes (pool lengths,
+        pending counters) — taking the core lock for it only added
+        contention on the insert pipeline; a momentarily stale heartbeat
+        choice is harmless (the next tick re-reads)."""
         if not self.control_timer.is_set:
-            with self.core_lock:
-                busy = self.core.busy()
+            busy = self.core.busy()
             ts = (
                 self.conf.heartbeat_timeout
                 if busy
@@ -418,8 +451,12 @@ class Node(StateManager):
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
         self.timers.record("request_sync", time.monotonic() - t0)
         t0 = time.monotonic()
+        # Lock-free ingest stage: decode + hash + one batch signature
+        # verification happen BEFORE the core lock; the lock then only
+        # covers the ordered insert + DivideRounds sweep.
+        prepared = self.core.prepare_sync(resp.events)
         with self.core_lock:
-            self._sync(peer.id, resp.events)
+            self._sync(peer.id, resp.events, prepared)
         self.timers.record("sync", time.monotonic() - t0)
         return resp.known
 
@@ -438,11 +475,17 @@ class Node(StateManager):
         self._request_eager_sync(peer.net_addr, wire)
         self.timers.record("eager_sync", time.monotonic() - t0)
 
-    def _sync(self, from_id: int, events: List[WireEvent]) -> None:
+    def _sync(
+        self,
+        from_id: int,
+        events: List[WireEvent],
+        prepared: Optional[PreparedSync] = None,
+    ) -> None:
         """Insert events + process the sig pool; callers hold core_lock
+        and SHOULD pass the prepare_sync output computed outside it
         (reference: node.go:591-615)."""
         try:
-            self.core.sync(from_id, events)
+            self.core.sync(from_id, events, prepared)
         except Exception as err:
             if not is_normal_self_parent_error(err):
                 raise
@@ -596,8 +639,11 @@ class Node(StateManager):
         success = True
         err: Optional[str] = None
         try:
+            # Same lock-shrink as _pull: the batch decode+verify stage
+            # runs before the lock, the lock covers only the inserts.
+            prepared = self.core.prepare_sync(cmd.events)
             with self.core_lock:
-                self._sync(cmd.from_id, cmd.events)
+                self._sync(cmd.from_id, cmd.events, prepared)
         except Exception as e:
             success = False
             err = str(e)

@@ -8,6 +8,7 @@ track per-peer connected flags.
 from __future__ import annotations
 
 import random
+import threading
 from typing import Dict, Optional, Protocol
 
 from ..peers.peer import Peer
@@ -23,11 +24,18 @@ class PeerSelector(Protocol):
 
 
 class RandomPeerSelector:
-    """reference: peer_selector.go:19-103."""
+    """reference: peer_selector.go:19-103.
+
+    Carries its OWN narrow lock: the selector is touched from gossip
+    worker threads (next / update_last) that deliberately do NOT hold the
+    node's core lock — selector state is independent of the hashgraph, so
+    serializing it on the core lock only added contention to the insert
+    pipeline."""
 
     def __init__(self, peer_set: PeerSet, self_id: int):
         self.peers = peer_set
         self.self_id = self_id
+        self._lock = threading.Lock()
         self._selectable: Dict[int, Peer] = {
             p.id: p for p in peer_set.peers if p.id != self_id
         }
@@ -40,19 +48,21 @@ class RandomPeerSelector:
     def update_last(self, peer_id: int, connected: bool) -> bool:
         """Record the outcome of the last gossip; returns True on a new
         connection (reference: peer_selector.go:62-77)."""
-        self.last = peer_id
-        if peer_id in self._connected:
-            old = self._connected[peer_id]
-            self._connected[peer_id] = connected
-            return connected and not old
-        return False
+        with self._lock:
+            self.last = peer_id
+            if peer_id in self._connected:
+                old = self._connected[peer_id]
+                self._connected[peer_id] = connected
+                return connected and not old
+            return False
 
     def next(self) -> Optional[Peer]:
         """reference: peer_selector.go:80-103."""
-        ids = list(self._selectable.keys())
-        if not ids:
-            return None
-        if len(ids) == 1:
-            return self._selectable[ids[0]]
-        candidates = [i for i in ids if i != self.last] or ids
-        return self._selectable[random.choice(candidates)]
+        with self._lock:
+            ids = list(self._selectable.keys())
+            if not ids:
+                return None
+            if len(ids) == 1:
+                return self._selectable[ids[0]]
+            candidates = [i for i in ids if i != self.last] or ids
+            return self._selectable[random.choice(candidates)]

@@ -630,10 +630,14 @@ def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _synthetic_stream(n_peers: int, n_events: int, seed: int = 1):
+def _synthetic_stream(
+    n_peers: int, n_events: int, seed: int = 1, return_keys: bool = False
+):
     """A deterministic random-gossip event stream: each event's self-parent
     is its creator's head, other-parent a random peer's head — the same
-    DAG shape live gossip produces, at controllable scale."""
+    DAG shape live gossip produces, at controllable scale.
+    ``return_keys`` additionally returns the per-peer private keys (the
+    ingest microbench needs a validator key that is IN the peer set)."""
     import random
 
     from babble_tpu.crypto.keys import generate_key
@@ -674,6 +678,8 @@ def _synthetic_stream(n_peers: int, n_events: int, seed: int = 1):
             heads[i] = e.hex()
             seqs[i] = idx
             events.append(e)
+    if return_keys:
+        return events, peers, keys
     return events, peers
 
 
@@ -692,6 +698,118 @@ def _replay_inserts(events, peers, accel=None):
         h.insert_event(e, set_wire_info=True)
         h.divide_rounds()
     return h
+
+
+def bench_ingest(n_peers: int = 8, n_events: int = 1024,
+                 sync_chunk: int = 256, seed: int = 3):
+    """Before/after microbench for the batched-ingest fast path (ISSUE 1):
+    the SAME wire-event stream pushed through Core.sync with
+
+    - ``per_event``: per-event scalar signature verification inside the
+      insert loop (the reference's shape — host batch verifier disabled);
+    - ``batched``: the prepare_sync pipeline — lock-free decode+hash and
+      ONE native batch-verify call per incoming sync.
+
+    Returns events/s for both arms plus the speedup and the fast arm's
+    ingest counters. Everything else (insert, DivideRounds, oracle
+    consensus) is identical between arms, so the delta is the
+    verification+decode pipeline itself."""
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph import Hashgraph, InmemStore
+    from babble_tpu.hashgraph.event import Event
+    from babble_tpu.node.core import Core
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.proxy.proxy import InmemProxy
+
+    events, peers, keys = _synthetic_stream(
+        n_peers, n_events, seed=seed, return_keys=True
+    )
+    # Source hashgraph assigns wire info (creatorID / parent indexes) so
+    # the stream can travel as WireEvents.
+    src = Hashgraph(InmemStore(100000))
+    src.init(peers)
+    replayed = []
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        src.insert_event(e, set_wire_info=True)
+        src.divide_rounds()
+        replayed.append(e)
+    wires = [e.to_wire() for e in replayed]
+    from_id = peers.peers[1].id
+
+    def run(batched: bool) -> float:
+        proxy = InmemProxy(DummyState())
+        core = Core(
+            Validator(keys[0], "ingest-bench"),
+            peers,
+            peers,
+            InmemStore(100000),
+            proxy.commit_block,
+        )
+        if not batched:
+            core._host_batch_verify = False  # per-event scalar baseline
+        # Pure ingest measurement: recording reply heads would fork the
+        # stream validator's chain (the bench core shares peer 0's key
+        # with the pre-signed stream); both arms skip it identically.
+        core.record_heads = lambda: None
+        t0 = time.perf_counter()
+        for pos in range(0, len(wires), sync_chunk):
+            chunk = wires[pos : pos + sync_chunk]
+            prepared = core.prepare_sync(chunk)
+            core.sync(from_id, chunk, prepared)
+        dt = time.perf_counter() - t0
+        if batched:
+            run.counters = {
+                "ingest_syncs": core.ingest_syncs,
+                "ingest_batch_verifies": core.ingest_batch_verifies,
+                "ingest_batch_size_max": core.ingest_batch_size_max,
+                "ingest_fallback_singles": core.ingest_fallback_singles,
+            }
+        return n_events / dt
+
+    eps_scalar = run(batched=False)
+    eps_batched = run(batched=True)
+    return {
+        "n_peers": n_peers,
+        "n_events": n_events,
+        "sync_chunk": sync_chunk,
+        "per_event_events_per_s": round(eps_scalar, 1),
+        "batched_events_per_s": round(eps_batched, 1),
+        "speedup": round(eps_batched / eps_scalar, 2),
+        **run.counters,
+    }
+
+
+# Keys dropped FIRST (in order) when the compact summary line would
+# exceed the driver's tail-capture budget.
+_SUMMARY_OPTIONAL_KEYS = (
+    "ingest",
+    "cfg3_threads_accel_txs_per_s",
+    "cfg3_threads_oracle_txs_per_s",
+    "cfg3_procs_txs_per_s",
+    "cfg4_churn_txs_per_s",
+    "cfg5_adversarial_txs_per_s",
+    "accel_txs_per_s",
+    "latency_p95_ms",
+    "latency_p50_ms",
+)
+
+
+def _compact_summary(fields: dict, limit: int = 2000) -> str:
+    """One-line JSON summary guaranteed under ``limit`` chars: the
+    driver's tail capture truncates long output (BENCH_r04/r05.parsed:
+    null), so the LAST stdout line is this parseable digest. Optional
+    keys are shed in order until the line fits; the headline metric
+    (committed_txs_per_s_4node) is never dropped."""
+    out = dict(fields)
+    line = json.dumps(out, separators=(",", ":"))
+    for key in _SUMMARY_OPTIONAL_KEYS:
+        if len(line) < limit:
+            break
+        out.pop(key, None)
+        line = json.dumps(out, separators=(",", ":"))
+    return line
 
 
 def bench_crossover():
@@ -1166,9 +1284,45 @@ def _best_of_two(label: str, **gossip_kwargs) -> dict:
     return best
 
 
+def main_smoke() -> None:
+    """Short CI smoke (`make benchsmoke`): a quick 4-node in-process run
+    plus the ingest microbench, emitting ONLY the compact summary line on
+    stdout — self-checked to parse as JSON and fit the tail-capture
+    budget. Never touches the device/jax (CI hosts have no TPU)."""
+    res = bench_gossip(target_txs=400, warmup_txs=100, timeout=90.0)
+    print(
+        f"smoke 4-node: {res['txs_per_s']} tx/s "
+        f"p50={res['latency_p50_ms']}ms",
+        file=sys.stderr,
+    )
+    try:
+        ingest = bench_ingest(n_peers=6, n_events=384, sync_chunk=128)
+        print(f"smoke ingest: {ingest}", file=sys.stderr)
+    except Exception as err:
+        ingest = {"error": f"{type(err).__name__}: {err}"}
+        print(f"smoke ingest failed: {err}", file=sys.stderr)
+    line = _compact_summary(
+        {
+            "bench_summary": "smoke",
+            "committed_txs_per_s_4node": res["txs_per_s"],
+            "vs_baseline": round(
+                res["txs_per_s"] / REFERENCE_LIVENESS_TXS, 2
+            ),
+            "latency_p50_ms": res["latency_p50_ms"],
+            "latency_p95_ms": res["latency_p95_ms"],
+            "ingest": ingest,
+        }
+    )
+    json.loads(line)  # the contract benchsmoke asserts
+    assert len(line) < 2000, "compact summary exceeded tail-capture budget"
+    print(line)
+
+
 def main() -> None:
     if "--all" in sys.argv:
         return main_all()
+    if "--smoke" in sys.argv:
+        return main_smoke()
     device_info = _resolve_bench_device()
     oracle = _best_of_two("4-node oracle path")
     try:
@@ -1337,6 +1491,22 @@ def main() -> None:
         config5 = {"error": f"{type(err).__name__}: {err}"}
         print(f"config 5 adversarial failed: {err}", file=sys.stderr)
 
+    # Batched-ingest fast path before/after (the ISSUE-1 pipeline): same
+    # stream, per-event scalar verify vs one batch-verify per sync.
+    try:
+        ingest = bench_ingest()
+        print(
+            f"ingest fast path: per-event={ingest['per_event_events_per_s']} "
+            f"ev/s batched={ingest['batched_events_per_s']} ev/s "
+            f"({ingest['speedup']}x, "
+            f"{ingest['ingest_batch_verifies']} batch verifies / "
+            f"{ingest['ingest_syncs']} syncs)",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        ingest = {"error": f"{type(err).__name__}: {err}"}
+        print(f"ingest microbench failed: {err}", file=sys.stderr)
+
     eps, dag_dt, device, dag_E, mfu, dag_err = bench_dag_pipeline_guarded()
 
     # Signature-verification economics on the resolved device (SURVEY §7
@@ -1380,6 +1550,7 @@ def main() -> None:
         "config5_adversarial": config5,
         "subprocess_4node": procs,
         "device_verify": device_verify,
+        "ingest_fastpath": ingest,
         "baseline_note": "reference CI liveness floor ~333 tx/s "
         "(node_test.go:536-631); reference publishes no numbers",
         "capture": "best_of_2 runs for headline + accelerated_4node "
@@ -1409,6 +1580,31 @@ def main() -> None:
         "extra": extra,
     }
     print(json.dumps(result))
+    # FINAL stdout line: the compact digest the driver's tail capture can
+    # always parse (the full result above regularly exceeds it).
+    print(
+        _compact_summary(
+            {
+                "bench_summary": "v1",
+                "committed_txs_per_s_4node": oracle["txs_per_s"],
+                "vs_baseline": result["vs_baseline"],
+                "capture_class": device_info["capture_class"],
+                "latency_p50_ms": oracle["latency_p50_ms"],
+                "latency_p95_ms": oracle["latency_p95_ms"],
+                "accel_txs_per_s": accel.get("txs_per_s"),
+                "cfg3_threads_oracle_txs_per_s": config3_threads.get(
+                    "oracle", {}
+                ).get("txs_per_s"),
+                "cfg3_threads_accel_txs_per_s": config3_threads.get(
+                    "accelerated", {}
+                ).get("txs_per_s"),
+                "cfg3_procs_txs_per_s": config3_procs.get("txs_per_s"),
+                "cfg4_churn_txs_per_s": config4.get("txs_per_s"),
+                "cfg5_adversarial_txs_per_s": config5.get("txs_per_s"),
+                "ingest": ingest,
+            }
+        )
+    )
 
 
 if __name__ == "__main__":

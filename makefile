@@ -28,9 +28,15 @@ dryrun:
 bench:
 	python bench.py
 
+# benchsmoke: short 4-node in-process bench; asserts the compact summary
+# line (the driver's tail-capture contract) parses as JSON and carries
+# the headline metric
+benchsmoke:
+	JAX_PLATFORMS=cpu python bench.py --smoke | tail -n 1 | python -c "import json,sys; line=sys.stdin.read().strip(); d=json.loads(line); assert 'committed_txs_per_s_4node' in d, 'summary missing headline metric'; assert len(line) < 2000, 'summary too long'; print('benchsmoke ok:', d['committed_txs_per_s_4node'], 'tx/s')"
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke wheel

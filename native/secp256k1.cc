@@ -18,10 +18,13 @@
 // Jacobian coordinates (a=0 doubling), Strauss-Shamir interleaved 4-bit
 // windows for u1*G + u2*Q with a precomputed affine G table.
 //
-// Build: g++ -O3 -shared -fPIC -o libbabble_crypto.so secp256k1.cc
+// Build: g++ -O3 -shared -fPIC -pthread -o libbabble_crypto.so secp256k1.cc
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -546,20 +549,24 @@ static void jac_to_aff(Aff &r, const Jac &p) {
 // ---------------------------------------------------------------------------
 
 static Aff G_TABLE[16];  // [i] = i*G, i in 1..15 ([0] unused)
-static bool g_table_ready = false;
+static std::once_flag g_table_once;
 
+// call_once, not a plain ready-flag: the Python side verifies batches
+// OUTSIDE its core lock, so two gossip threads can race the first
+// bt_verify_batch — an unsynchronized lazy init is a data race, and on
+// weakly-ordered CPUs a reader could see the flag before the table.
 static void init_g_table() {
-    if (g_table_ready) return;
-    Jac acc;
-    jac_from_aff(acc, G_AFF);
-    Jac cur = acc;
-    for (int i = 1; i <= 15; i++) {
-        jac_to_aff(G_TABLE[i], cur);
-        Jac next;
-        jac_add_aff(next, cur, G_AFF);
-        cur = next;
-    }
-    g_table_ready = true;
+    std::call_once(g_table_once, [] {
+        Jac acc;
+        jac_from_aff(acc, G_AFF);
+        Jac cur = acc;
+        for (int i = 1; i <= 15; i++) {
+            jac_to_aff(G_TABLE[i], cur);
+            Jac next;
+            jac_add_aff(next, cur, G_AFF);
+            cur = next;
+        }
+    });
 }
 
 // scalar * G using the affine table, 4-bit windows MSB-first
@@ -734,10 +741,38 @@ extern "C" {
 int bt_has_native(void) { return 1; }
 
 // pub: n*64 bytes (x||y big-endian), msg: n*32, rs: n*64 (r||s), out: n bytes
+//
+// Large batches fan out over the hardware threads: Python releases the
+// GIL for the ctypes call, so a whole sync's signatures verify on every
+// core while the host thread is free — the per-signature EC math is
+// embarrassingly parallel and signature-independent. Small batches stay
+// serial (thread spawn costs more than the work below ~8 sigs/thread).
 void bt_verify_batch(const u8 *pub, const u8 *msg, const u8 *rs, int n,
                      u8 *out) {
-    for (int i = 0; i < n; i++)
-        out[i] = verify_one(pub + 64 * i, msg + 32 * i, rs + 64 * i) ? 1 : 0;
+    if (n <= 0) return;
+    init_g_table();  // concurrent callers race the lazy init otherwise
+    int nthreads = int(std::thread::hardware_concurrency());
+    if (nthreads < 1) nthreads = 1;
+    if (nthreads > n / 8) nthreads = n / 8;  // >= 8 sigs per thread
+    if (nthreads > 16) nthreads = 16;
+    if (nthreads <= 1) {
+        for (int i = 0; i < n; i++)
+            out[i] = verify_one(pub + 64 * i, msg + 32 * i, rs + 64 * i)
+                         ? 1 : 0;
+        return;
+    }
+    std::vector<std::thread> workers;
+    workers.reserve(nthreads);
+    for (int t = 0; t < nthreads; t++) {
+        int lo = int((long)n * t / nthreads);
+        int hi = int((long)n * (t + 1) / nthreads);
+        workers.emplace_back([=] {
+            for (int i = lo; i < hi; i++)
+                out[i] = verify_one(pub + 64 * i, msg + 32 * i, rs + 64 * i)
+                             ? 1 : 0;
+        });
+    }
+    for (auto &w : workers) w.join();
 }
 
 // returns 0 on success, nonzero on bad private key

@@ -30,7 +30,7 @@ class BuildPyWithNative(build_py):
         so = os.path.join(dest_dir, "libbabble_crypto.so")
         try:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", so,
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", so,
                  os.path.join(dest_dir, "secp256k1.cc")],
                 check=True,
                 capture_output=True,

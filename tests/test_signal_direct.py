@@ -328,3 +328,67 @@ def test_gossip_survives_relay_shutdown(server):
         check_gossip(nodes, 0, max(marks) + 2)
     finally:
         shutdown_all(nodes)
+
+
+def test_cross_dial_symmetry_broken_deterministically(server):
+    """Simultaneous-offer tie-break: of any pair, exactly ONE side (the
+    lexicographically smaller pubkey) dials; the other waits for the
+    inbound handshake. Both-dial produced crossing sockets whose
+    latest-wins adoption could close the link the peer still used
+    (the ~1/3 upgrade flake)."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka, timeout=5.0,
+                         direct_listen="127.0.0.1:0")
+    tb = SignalTransport(server.addr(), kb, timeout=5.0,
+                         direct_listen="127.0.0.1:0")
+    try:
+        a_dials = ta._should_dial(ta._norm(kb.public_key.hex()))
+        b_dials = tb._should_dial(tb._norm(ka.public_key.hex()))
+        assert a_dials != b_dials, "exactly one side must dial"
+        smaller_dials = (
+            a_dials if ta._pub < tb._pub else b_dials
+        )
+        assert smaller_dials, "the smaller pubkey is the dialer"
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_larger_side_fallback_dial_covers_one_sided_reachability(
+    server, monkeypatch
+):
+    """If the deterministic (smaller-pubkey) dialer cannot reach the
+    larger peer — e.g. its endpoint is NAT'd — the larger side's
+    grace-period fallback dial must still upgrade the pair."""
+    ka, kb = generate_key(), generate_key()
+    ta = SignalTransport(server.addr(), ka, timeout=20.0,
+                         direct_listen="127.0.0.1:0")
+    tb = SignalTransport(server.addr(), kb, timeout=20.0,
+                         direct_listen="127.0.0.1:0")
+    smaller, larger = (
+        (ta, tb) if ta._pub < tb._pub else (tb, ta)
+    )
+    monkeypatch.setattr(
+        type(larger), "FALLBACK_DIAL_GRACE_S", 0.5, raising=True
+    )
+    # the smaller side's dials all fail (the larger's addr is
+    # "unreachable" to it)
+    monkeypatch.setattr(
+        smaller, "_direct_connect",
+        lambda peer, addr: smaller._rearm_offer(peer),
+    )
+    ta.listen()
+    tb.listen()
+    stop = threading.Event()
+    _responder(tb, stop)
+    try:
+        resp = ta.sync(kb.public_key.hex(), SyncRequest(1, {}, 100))
+        assert isinstance(resp, SyncResponse)
+        assert _wait_direct(ta, kb.public_key.hex(), timeout=20.0), (
+            "fallback dial never upgraded the pair"
+        )
+        assert _wait_direct(tb, ka.public_key.hex(), timeout=20.0)
+    finally:
+        stop.set()
+        ta.close()
+        tb.close()

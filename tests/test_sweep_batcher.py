@@ -230,3 +230,58 @@ def test_accel_with_batcher_matches_oracle(graph):
     h.flush_consensus()
     assert h.accel.fallbacks == 0
     assert _consensus_state(h) == _consensus_state(oracle)
+
+
+def test_target_bucket_decays_after_sustained_small_waves():
+    """One oversized window must not permanently inflate the padded
+    shapes: after DECAY_WAVES consecutive waves strictly below the
+    target, the bucket shrinks back to the observed per-wave max."""
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    b = SweepBatcher.__new__(SweepBatcher)  # no dispatcher thread
+    b._target = None
+    b._below_waves = 0
+    b._decay_max = None
+    b.target_decays = 0
+
+    small = (8, 4, 2, 2, 2)
+    spike = (64, 32, 8, 8, 8)
+
+    assert b._update_target(small) == small
+    # one oversized wave inflates the target (monotone growth preserved)
+    assert b._update_target(spike) == spike
+    # small waves keep padding to the spike shape for DECAY_WAVES...
+    for _ in range(SweepBatcher.DECAY_WAVES - 1):
+        assert b._update_target(small) == spike
+    # ...then the bucket decays to the observed max of the window
+    assert b._update_target(small) == small
+    assert b.target_decays == 1
+    # regrowth still works after a decay
+    assert b._update_target(spike) == spike
+
+
+def test_target_bucket_decay_resets_on_regrowth():
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    b = SweepBatcher.__new__(SweepBatcher)
+    b._target = None
+    b._below_waves = 0
+    b._decay_max = None
+    b.target_decays = 0
+
+    small = (8, 4, 2, 2, 2)
+    mid = (16, 8, 4, 4, 4)
+    spike = (64, 32, 8, 8, 8)
+
+    b._update_target(spike)
+    for _ in range(SweepBatcher.DECAY_WAVES - 1):
+        b._update_target(small)
+    # a wave AT the target resets the observation window: no decay yet
+    assert b._update_target(spike) == spike
+    for _ in range(SweepBatcher.DECAY_WAVES - 1):
+        assert b._update_target(mid) == spike
+    assert b.target_decays == 0
+    # the decayed bucket is the window's observed max, not the smallest
+    b._update_target(mid)
+    assert b._target == mid
+    assert b.target_decays == 1
