@@ -44,9 +44,11 @@ class _Inflight:
     back while gossip continues."""
 
     __slots__ = ("win", "result", "error", "done", "generation", "t_launch",
-                 "t_done", "topo", "_slots", "_slot_lock", "_slot_held")
+                 "t_done", "topo", "snap", "readback_s", "_slots",
+                 "_slot_lock", "_slot_held")
 
-    def __init__(self, win, generation: int, topo: int, slots=None):
+    def __init__(self, win, generation: int, topo: int, slots=None,
+                 snap=None):
         self.win = win
         self.result = None  # (fame, rr) numpy arrays once read back
         self.error: Optional[BaseException] = None
@@ -55,6 +57,11 @@ class _Inflight:
         self.t_launch = time.perf_counter()
         self.t_done = 0.0  # set by the reader when the readback lands
         self.topo = topo  # hashgraph topological index at snapshot time
+        # Resident-window provenance: the WindowState snapshot this sweep
+        # was launched from (None on the legacy full-build path). Its
+        # generation gates apply — see TensorConsensus._apply.
+        self.snap = snap
+        self.readback_s = 0.0  # device→host wait measured by the reader
         # Admission-control slot ownership: released exactly once, by the
         # reader when the readback lands OR by the abandonment path when a
         # wedged readback times out — whichever gets there first.
@@ -157,7 +164,8 @@ class TensorConsensus:
                  min_window: int | None = None,
                  pipeline: bool | None = None,
                  mesh=None,
-                 batcher: bool | None = None):
+                 batcher: bool | None = None,
+                 resident: bool | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -190,12 +198,26 @@ class TensorConsensus:
         # from BABBLE_ACCEL_BATCH at first flush. Mutually exclusive with
         # mesh sharding (the batcher dispatches single-device programs).
         self.batcher = batcher
+        # Incremental device-resident windows (ops/window_state.py): the
+        # snapshot is a persistent WindowState updated in O(ΔE) per sweep,
+        # and the window tensors stay on the device between sweeps (the
+        # resident program donates the previous buffers and applies a
+        # compact delta). None = resolve from BABBLE_ACCEL_RESIDENT at
+        # first flush (default ON); forced off under mesh sharding (the
+        # sharded program owns its placement). With the batcher, the host
+        # side stays incremental but windows are submitted as copies (the
+        # vmapped batch program cannot donate per-node buffers).
+        self.resident = resident
+        self.window_state = None
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0
         self.small_windows = 0  # flushes routed to the oracle by min_window
         self.deferred = 0  # flushes that rode behind an in-flight readback
         self.contended = 0  # launches skipped: device at max in-flight sweeps
+        self.stale_drops = 0  # readbacks discarded by the generation check
+        self.rows_delta_total = 0  # delta rows uploaded across sweeps
+        self.rows_reused_total = 0  # resident rows reused across sweeps
         self.generation = 0  # bumped by Hashgraph.reset/bootstrap
         # A sweep whose readback exceeds this is abandoned (tunnel wedge):
         # the oracle takes over so a dead device can stall only one sweep's
@@ -206,7 +228,13 @@ class TensorConsensus:
         self.total_sweep_s = 0.0
         self.last_window_events = 0
         # Per-stage rolling sums (seconds) for /debug and bench breakdowns.
-        self.stage_s = {"build": 0.0, "kernel": 0.0, "apply": 0.0}
+        # snapshot cost = build (full rebuilds) + delta_scan + pack
+        # (incremental); dispatch/readback split the old "kernel" stage so
+        # a transfer regression is distinguishable from a compute one.
+        self.stage_s = {
+            "build": 0.0, "delta_scan": 0.0, "pack": 0.0,
+            "dispatch": 0.0, "readback": 0.0, "kernel": 0.0, "apply": 0.0,
+        }
         self._inflight: Optional[_Inflight] = None
         self._compiling = set()
         self._lock = threading.Lock()
@@ -251,6 +279,10 @@ class TensorConsensus:
             inf.release_slot()
         self._inflight = None
         self._last_snapshot_topo = -1
+        if self.window_state is not None:
+            # drop residency + force a rebuild: the mirrors describe a
+            # store that no longer exists
+            self.window_state.mark_dirty("invalidate")
 
     # -- compile management -------------------------------------------------
 
@@ -323,7 +355,22 @@ class TensorConsensus:
 
     def flush(self, hg) -> bool:
         """Handle one consensus flush. Returns False when the caller must
-        run the oracle voting stages instead."""
+        run the oracle voting stages instead — and marks the resident
+        window state dirty in that case, because the oracle pass that
+        follows mutates fame/round-received state the mirrors can't track
+        in O(ΔE); the next engaged snapshot rebuilds from scratch."""
+        handled = self._flush(hg)
+        if not handled and self.window_state is not None:
+            self.window_state.mark_dirty("oracle-pass")
+            # Discard the hashgraph's delta channels too: the rebuild that
+            # follows reads the store directly, and on a node whose
+            # windows never clear the min_window gate NO snapshot ever
+            # drains them — without this they'd grow one entry per
+            # witness/fd-update forever.
+            hg.drain_accel_delta()
+        return handled
+
+    def _flush(self, hg) -> bool:
         from babble_tpu.ops.device import jax_usable
 
         if not jax_usable():
@@ -360,6 +407,19 @@ class TensorConsensus:
                 from babble_tpu.ops.device import on_accelerator
 
                 self.batcher = on_accelerator() and self.mesh is None
+        if self.resident is None:
+            self.resident = resident_default_on()
+        if self.mesh is not None:
+            # the sharded program owns its own placement; residency and
+            # donation are single-device disciplines
+            self.resident = False
+        if self.resident and self.window_state is None:
+            from babble_tpu.ops.window_state import WindowState
+
+            self.window_state = WindowState()
+        # turn on the hashgraph's delta channels (new witnesses, fd
+        # mutations) exactly when a WindowState consumes them
+        hg._accel_track_delta = bool(self.resident)
         if not self.pipeline:
             if not self.use_device(len(hg.undetermined_events)):
                 return False
@@ -429,20 +489,102 @@ class TensorConsensus:
             )
         return voting.launch_sweep(win)
 
+    def _snapshot(self, hg, for_batcher: bool = False):
+        """This sweep's window: the legacy from-scratch build, or — in
+        resident mode — an O(ΔE) WindowState snapshot (delta over the
+        persistent mirrors, rebuilding only when a trigger fires).
+        Returns (win, snap); win None ⇒ nothing to decide; snap None on
+        the legacy path. ``for_batcher`` snapshots copied row arrays so
+        the batcher's asynchronous dispatch never reads mirrors a later
+        delta mutated in place."""
+        from babble_tpu.ops import voting
+
+        if not self.resident:
+            t0 = time.perf_counter()
+            win = voting.build_voting_window(hg)
+            self.stage_s["build"] += time.perf_counter() - t0
+            return win, None
+        timers: dict = {}
+        try:
+            snap = self.window_state.snapshot(
+                hg, timers, copy_rows=for_batcher
+            )
+        finally:
+            for k, v in timers.items():
+                self.stage_s[k] = self.stage_s.get(k, 0.0) + v
+        if snap is None:
+            return None, None
+        self.rows_delta_total += snap.rows_delta
+        self.rows_reused_total += snap.rows_reused
+        return snap.win, snap
+
+    def _dispatch_snap(self, win, snap):
+        """Dispatch one sweep. With a WindowState snapshot, the window
+        stays device-resident: the delta program (once warm) donates the
+        previous buffers and uploads only the delta; until it is warm the
+        full-upload path reseeds residency through the plain program while
+        a background thread compiles the delta program."""
+        if snap is None or self.batcher or self._use_mesh(win):
+            return self._dispatch(win)
+        from babble_tpu.ops import window_state as ws
+
+        state = self.window_state
+        if (
+            snap.delta is not None
+            and state.device is not None
+            and self.async_compile
+            and not ws.resident_ready(state.key)
+        ):
+            self._kick_resident(state.key)
+        out, _used_delta = state.dispatch(
+            snap, allow_inline_compile=not self.async_compile
+        )
+        return out
+
+    def _kick_resident(self, key: tuple) -> None:
+        from babble_tpu.ops import window_state as ws
+
+        gate = (key, "resident")
+        with self._lock:
+            if gate in self._compiling:
+                return
+            self._compiling.add(gate)
+
+        def work() -> None:
+            try:
+                t0 = time.perf_counter()
+                ws.precompile_resident(*key)
+                logger.info(
+                    "resident delta program ready for bucket %s in %.1fs",
+                    key, time.perf_counter() - t0,
+                )
+            except Exception:
+                logger.warning(
+                    "resident precompile failed for %s", key, exc_info=True
+                )
+            finally:
+                with self._lock:
+                    self._compiling.discard(gate)
+
+        threading.Thread(target=work, daemon=True,
+                         name="resident-compile").start()
+
     def _launch(self, hg) -> bool:
         from babble_tpu.ops import voting
 
-        t0 = time.perf_counter()
         try:
-            win = voting.build_voting_window(hg)
+            win, snap = self._snapshot(hg, for_batcher=bool(self.batcher))
             if win is None:
                 return True  # nothing undecided
             if not self._bucket_ready(win):
+                if snap is not None:
+                    # the snapshot's delta is committed to the mirrors but
+                    # never reached the device — reseed residency later
+                    self.window_state.drop_residency()
                 return False
         except Exception as err:
             self._note_fallback(err)
             return False
-        self.stage_s["build"] += time.perf_counter() - t0
 
         if self.batcher:
             # Co-located batching: the process-wide batcher coalesces this
@@ -455,12 +597,19 @@ class TensorConsensus:
                 # backlogged: the oracle carries this flush (same
                 # economics as losing an admission slot)
                 self.contended += 1
+                if snap is not None:
+                    self.window_state.drop_residency()
                 return False
-            inf = _Inflight(win, self.generation, hg.topological_index, None)
+            inf = _Inflight(win, self.generation, hg.topological_index,
+                            None, snap)
 
             def batch_reader() -> None:
                 try:
+                    t_r = time.perf_counter()
                     ticket.done.wait()
+                    # coalesce wait + dispatch + readback, from this
+                    # node's perspective
+                    inf.readback_s = time.perf_counter() - t_r
                     if ticket.error is not None:
                         inf.error = ticket.error
                     else:
@@ -491,14 +640,21 @@ class TensorConsensus:
             # share it): let the oracle carry this flush instead of
             # joining a readback convoy.
             self.contended += 1
+            if snap is not None:
+                self.window_state.drop_residency()
             return False
-        inf = _Inflight(win, self.generation, hg.topological_index, slots)
+        inf = _Inflight(win, self.generation, hg.topological_index, slots,
+                        snap)
         try:
-            out = self._dispatch(win)
+            t_d = time.perf_counter()
+            out = self._dispatch_snap(win, snap)
+            self.stage_s["dispatch"] += time.perf_counter() - t_d
 
             def reader() -> None:
                 try:
+                    t_r = time.perf_counter()
                     inf.result = voting.read_sweep(out, inf.win)
+                    inf.readback_s = time.perf_counter() - t_r
                 except BaseException as e:  # device/tunnel failure
                     inf.error = e
                 finally:
@@ -524,17 +680,31 @@ class TensorConsensus:
         if inf.error is not None:
             self._note_fallback(inf.error)
             return False
+        state = self.window_state
+        if inf.snap is not None and (
+            state is None or inf.snap.generation != state.generation
+        ):
+            # Donation/generation safety: the resident state mutated after
+            # this sweep launched (rebuild, invalidate, a newer snapshot),
+            # so its row maps no longer describe these results. Discard
+            # them — the oracle carries this flush and the dirty state
+            # rebuilds at the next snapshot.
+            self.stale_drops += 1
+            return False
         try:
             fame, rr = inf.result
-            voting.apply_fame(hg, inf.win, fame)
-            voting.apply_round_received(hg, inf.win, rr)
+            _decided, fame_applied = voting.apply_fame(hg, inf.win, fame)
+            received = voting.apply_round_received(hg, inf.win, rr)
         except Exception as err:
             self._note_fallback(err)
             return False
+        if inf.snap is not None and state is not None:
+            state.note_applied(fame_applied, received)
         t_apply = time.perf_counter() - t0
         kernel_s = inf.t_done - inf.t_launch  # dispatch+kernel+readback
         self.stage_s["apply"] += t_apply
         self.stage_s["kernel"] += kernel_s
+        self.stage_s["readback"] += inf.readback_s
         self.sweeps += 1
         self.last_window_events = len(inf.win.hashes)
         # Sweep cost, not launch-to-apply wall time (the latter includes
@@ -553,13 +723,12 @@ class TensorConsensus:
 
         t0 = time.perf_counter()
         try:
-            win = voting.build_voting_window(hg)
+            win, snap = self._snapshot(hg, for_batcher=bool(self.batcher))
             if win is None:
                 return True  # nothing undecided
             if not self._bucket_ready(win):
                 return False
             t1 = time.perf_counter()
-            self.stage_s["build"] += t1 - t0
             if self.batcher:
                 # Synchronous mode still coalesces with concurrent nodes:
                 # submit and wait — co-located threads flushing in the
@@ -570,6 +739,8 @@ class TensorConsensus:
                 if ticket is None:
                     self.contended += 1
                     return False
+                self.stage_s["dispatch"] += time.perf_counter() - t1
+                t_r = time.perf_counter()
                 if not ticket.done.wait(self.readback_timeout_s):
                     raise TimeoutError(
                         f"batched sweep exceeded {self.readback_timeout_s:.0f}s"
@@ -577,12 +748,19 @@ class TensorConsensus:
                 if ticket.error is not None:
                     raise ticket.error
                 fame, rr = ticket.result
+                self.stage_s["readback"] += time.perf_counter() - t_r
             else:
-                fame, rr = voting.read_sweep(self._dispatch(win), win)
+                out = self._dispatch_snap(win, snap)
+                t_r = time.perf_counter()
+                self.stage_s["dispatch"] += t_r - t1
+                fame, rr = voting.read_sweep(out, win)
+                self.stage_s["readback"] += time.perf_counter() - t_r
             t2 = time.perf_counter()
             self.stage_s["kernel"] += t2 - t1
-            voting.apply_fame(hg, win, fame)
-            voting.apply_round_received(hg, win, rr)
+            _decided, fame_applied = voting.apply_fame(hg, win, fame)
+            received = voting.apply_round_received(hg, win, rr)
+            if snap is not None and self.window_state is not None:
+                self.window_state.note_applied(fame_applied, received)
             self.stage_s["apply"] += time.perf_counter() - t2
         except Exception as err:
             self._note_fallback(err)
@@ -599,6 +777,10 @@ class TensorConsensus:
         # are ordered so no partial mutation precedes a fallible read (see
         # apply_round_received), making the oracle re-run safe.
         self.fallbacks += 1
+        if self.window_state is not None:
+            # the oracle pass that follows mutates state the mirrors can't
+            # track; the next snapshot must rebuild
+            self.window_state.mark_dirty("fallback")
         if isinstance(err, StoreError):
             logger.warning("accelerated sweep fell back to oracle: %s", err)
         else:
@@ -642,15 +824,40 @@ class TensorConsensus:
             "accel_last_sweep_ms": round(1000.0 * self.last_sweep_s, 3),
             "accel_avg_sweep_ms": round(avg_ms, 3),
             "accel_last_window_events": self.last_window_events,
+            # Per-stage breakdown (ms totals): snapshot cost is build (full
+            # rebuilds) + delta_scan + pack (incremental); dispatch and
+            # readback split the device leg; kernel is the legacy combined
+            # dispatch→readback wall time.
             "accel_stage_ms": {
                 k: round(1000.0 * v, 1) for k, v in self.stage_s.items()
             },
+            # Resident-window counters: delta rows uploaded vs rows served
+            # from the device-resident buffers, and how often the
+            # incremental state fell back to a from-scratch rebuild.
+            "accel_resident": bool(self.resident),
+            "accel_rows_delta": self.rows_delta_total,
+            "accel_rows_reused": self.rows_reused_total,
+            "accel_rebuilds": (
+                self.window_state.rebuilds
+                if self.window_state is not None
+                else 0
+            ),
+            "accel_stale_drops": self.stale_drops,
         }
         if self.batcher:
             from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
 
             out.update(SweepBatcher.instance().stats())
         return out
+
+
+def resident_default_on() -> bool:
+    """Whether TensorConsensus will resolve resident=True with default
+    settings (BABBLE_ACCEL_RESIDENT unset or not \"0\"). Used by prewarm
+    to decide whether the resident delta programs are worth compiling."""
+    import os
+
+    return os.environ.get("BABBLE_ACCEL_RESIDENT") != "0"
 
 
 def batcher_default_on() -> bool:
@@ -748,6 +955,23 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
                     logger.warning(
                         "prewarm failed for %s", key, exc_info=True
                     )
+            if mesh is None and resident_default_on() and not batcher_default_on():
+                # resident delta program for the same bucket (a separate
+                # executable): first delta sweeps then meet a warm
+                # program instead of riding full uploads while a
+                # background compile catches up. With the batcher on,
+                # sweeps ride the vmapped program and the resident
+                # executable would never run — don't burn compiles on it.
+                from babble_tpu.ops import window_state as ws
+
+                if not ws.resident_ready(key):
+                    try:
+                        ws.precompile_resident(*key)
+                    except Exception:
+                        logger.warning(
+                            "resident prewarm failed for %s", key,
+                            exc_info=True,
+                        )
 
     if background:
         t = threading.Thread(target=work, daemon=True, name="voting-prewarm")

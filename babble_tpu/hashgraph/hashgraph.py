@@ -108,6 +108,18 @@ class Hashgraph:
         # insert; inserts between sweeps are counted in _accel_pending.
         self.accel = None
         self._accel_pending = 0
+        # Delta channels for the accelerator's incremental WindowState
+        # (ops/window_state.py): the insert path records the two mutations
+        # a window snapshot cannot otherwise discover in O(ΔE) — witnesses
+        # minted by divide_rounds (possibly into OLD rounds, via laggards)
+        # and post-insert first_descendant updates on already-stored
+        # events. Collection is gated on _accel_track_delta, which the
+        # TensorConsensus sets once it resolves its resident mode, so the
+        # channels cost nothing on the oracle path and can never grow
+        # unconsumed.
+        self._accel_track_delta = False
+        self._accel_new_witnesses: List[tuple] = []  # (round, hash)
+        self._accel_fd_dirty: set = set()  # event hashes with new fds
 
         cs = store.cache_size()
         self._ancestor_cache = LRU(cs)
@@ -370,6 +382,8 @@ class Hashgraph:
                 if creator not in a.first_descendants:
                     a.first_descendants[creator] = coords
                     self.store.set_event(a)
+                    if self._accel_track_delta:
+                        self._accel_fd_dirty.add(ah)
                     # Stop at witnesses so the walk doesn't descend to the
                     # bottom of the graph (reference: hashgraph.go:503-512).
                     try:
@@ -438,6 +452,14 @@ class Hashgraph:
             self._accel_pending > 0 or self.accel.busy()
         ):
             self.run_consensus_sweep()
+
+    def drain_accel_delta(self) -> tuple:
+        """Hand the accumulated delta channels to the accelerator's window
+        state (consumed exactly once per snapshot): (new_witnesses,
+        fd_dirty). New-witness order is divide_rounds order."""
+        nw, self._accel_new_witnesses = self._accel_new_witnesses, []
+        fd, self._accel_fd_dirty = self._accel_fd_dirty, set()
+        return nw, fd
 
     def run_consensus_sweep(self) -> None:
         """One batched voting sweep: device kernels when the undecided
@@ -577,6 +599,8 @@ class Hashgraph:
 
             round_info.add_created_event(hash_, is_witness)
             self.store.set_round(round_number, round_info)
+            if is_witness and self._accel_track_delta:
+                self._accel_new_witnesses.append((round_number, hash_))
 
         if ev.lamport_timestamp is None:
             # fallible read evaluated before the mutation, same rationale
@@ -973,6 +997,8 @@ class Hashgraph:
         self.pending_loaded_events = 0
         self.topological_index = 0
         self._accel_pending = 0
+        self._accel_new_witnesses = []
+        self._accel_fd_dirty = set()
         if self.accel is not None:
             # An in-flight sweep's snapshot no longer describes this store.
             self.accel.invalidate()

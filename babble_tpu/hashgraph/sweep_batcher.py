@@ -167,6 +167,31 @@ class SweepBatcher:
     def _dispatch_group(self, group: List[Ticket]) -> None:
         from babble_tpu.ops import voting
 
+        # Resident-state generation gate: windows snapshotted from a
+        # persistent WindowState carry (state, generation). If the state
+        # mutated between submit and dispatch (a rebuild, an invalidate),
+        # the window's row maps are stale — computing it would hand the
+        # owner results it must discard anyway, so fail the ticket now and
+        # let that node's oracle carry the flush. This is what keys a
+        # batched wave to the resident-state generation: stale generations
+        # never ride a dispatch.
+        fresh: List[Ticket] = []
+        for t in group:
+            state = getattr(t.win, "state", None)
+            if state is not None and state.generation != t.win.generation:
+                from babble_tpu.ops.window_state import StaleWindowError
+
+                t.error = StaleWindowError(
+                    f"window generation {t.win.generation} != state "
+                    f"generation {state.generation}"
+                )
+                t.done.set()
+                continue
+            fresh.append(t)
+        group = fresh
+        if not group:
+            return
+
         # Co-located nodes at slightly different DAG progress land in
         # DIFFERENT shape buckets; grouping by exact bucket would leave
         # every wave as singles. Instead the whole wave re-pads to the

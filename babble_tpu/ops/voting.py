@@ -125,6 +125,14 @@ class VotingWindow:
     row: Dict[str, int] = field(default_factory=dict)
     wit_hashes: List[str] = field(default_factory=list)  # real W rows
     wit_row: Dict[str, int] = field(default_factory=dict)
+    # Resident-window provenance (ops/window_state.py): windows snapshotted
+    # from a persistent WindowState carry the state's generation at
+    # snapshot time plus a back-reference, so downstream consumers (the
+    # sweep batcher, TensorConsensus._apply) can detect that the state
+    # mutated underneath them and discard stale results instead of
+    # applying them through moved row maps.
+    generation: int = 0
+    state: Optional[object] = None
 
     @property
     def n_events(self) -> int:
@@ -348,10 +356,15 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
 
     floors = list(pending)
     undet_rounds: Dict[str, int] = {}
+    # Events fetched for the floor computation are reused by the row-fill
+    # loop below — the undetermined set dominates E, so fetching each row
+    # twice doubled the store traffic of every rebuild.
+    ev_cache: Dict[str, object] = {}
     for h in undetermined:
         ev = store.get_event(h)
         if ev.round is None:
             return None  # divide_rounds has not run yet
+        ev_cache[h] = ev
         undet_rounds[h] = ev.round
         floors.append(ev.round)
     base = min(floors)
@@ -407,7 +420,9 @@ def build_voting_window(hg) -> Optional[VotingWindow]:
     from babble_tpu.hashgraph.hashgraph import middle_bit
 
     for h, i in rows.items():
-        ev = store.get_event(h)
+        ev = ev_cache.get(h)
+        if ev is None:
+            ev = store.get_event(h)
         creator[i] = peer_col[ev.creator()]
         index[i] = ev.index()
         if h in undet_rounds:
@@ -575,6 +590,8 @@ def repad_window(win: VotingWindow, key: tuple) -> VotingWindow:
         row=win.row,
         wit_hashes=win.wit_hashes,
         wit_row=win.wit_row,
+        generation=win.generation,
+        state=win.state,
     )
 
 
@@ -726,12 +743,16 @@ def run_sweep(win: VotingWindow):
     return read_sweep(launch_sweep(win), win)
 
 
-def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
+def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> tuple:
     """Write fame into the pending rounds' infos and mark decided rounds
     with the oracle's own sticky rule (mirrors the tail of
-    Hashgraph.decide_fame, hashgraph.go:985-996). Returns decided rounds."""
+    Hashgraph.decide_fame, hashgraph.go:985-996). Returns
+    (decided_rounds, applied): ``applied`` is the exact [(hash, ±1)] list
+    of set_fame writes, which the incremental WindowState replays into its
+    fame mirror at the next snapshot."""
     store = hg.store
     decided_rounds: List[int] = []
+    applied: List[tuple] = []
     for pr in hg.pending_rounds.get_ordered_pending_rounds():
         try:
             ri = store.get_round(pr.index)
@@ -747,17 +768,19 @@ def apply_fame(hg, win: VotingWindow, fame: np.ndarray) -> List[int]:
             f = int(fame[i])
             if f != 0:
                 ri.set_fame(x, f == 1)
+                applied.append((x, f))
         if ri.witnesses_decided(ps):
             decided_rounds.append(pr.index)
         store.set_round(pr.index, ri)
     hg.pending_rounds.update(decided_rounds)
-    return decided_rounds
+    return decided_rounds, applied
 
 
-def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:
+def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> List[str]:
     """Stamp received events and retire them from the undetermined list, in
     the oracle's scan order (mirrors Hashgraph.decide_round_received,
-    hashgraph.go:1047-1091)."""
+    hashgraph.go:1047-1091). Returns the received hashes — the exact row
+    releases the incremental WindowState applies at the next snapshot."""
     store = hg.store
     # Two-phase: gather every fallible store read first so a StoreError can
     # abort BEFORE any mutation — a partially-applied receive pass followed
@@ -786,3 +809,4 @@ def apply_round_received(hg, win: VotingWindow, rr: np.ndarray) -> None:
     for a, tr in round_infos.items():
         store.set_round(a, tr)
     hg.undetermined_events = new_undetermined
+    return [ev.hex() for ev, _ in updates]

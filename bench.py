@@ -258,6 +258,11 @@ def bench_gossip(
             "accel_pipeline",
             "accel_batcher",
             "accel_pallas",
+            "accel_resident",
+            "accel_rows_delta",
+            "accel_rows_reused",
+            "accel_rebuilds",
+            "accel_stale_drops",
         ):
             if key in ("accel_sweeps", "accel_fallbacks"):
                 out[key] = sum(int(s.get(key) or 0) for s in stats)
@@ -265,6 +270,127 @@ def bench_gossip(
                 out[key] = best.get(key)
     for n in nodes:
         n.shutdown()
+    return out
+
+
+def bench_dag_incremental(n_peers: int = 16, n_events: int = 512,
+                          chunk: int = 32, seed: int = 5,
+                          warm: bool = True) -> dict:
+    """Steady-state live-sweep arm of the dag_pipeline microbench (ISSUE 2):
+    the SAME synthetic gossip stream driven through
+    ``insert → divide_rounds → TensorConsensus sweep every ``chunk``
+    inserts``, once with from-scratch window rebuilds per sweep
+    (resident=False — the pre-ISSUE-2 shape) and once with the
+    incremental, device-resident WindowState. Reports the per-stage
+    breakdown per sweep plus the rows_delta/rows_reused/rebuilds counters,
+    and cross-checks that both arms commit identical blocks
+    (``consensus_match``).
+
+    ``warm``: run each arm once un-measured first so the jit cache is hot
+    and the measured sweeps never include XLA compiles."""
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+    from babble_tpu.hashgraph.accel import TensorConsensus
+
+    events, peers = _synthetic_stream(n_peers, n_events, seed=seed)
+
+    def run(resident: bool):
+        acc = TensorConsensus(sweep_events=chunk, async_compile=False,
+                              min_window=0, pipeline=False,
+                              batcher=False, resident=resident)
+        h = Hashgraph(InmemStore(100000))
+        h.init(peers)
+        h.accel = acc
+        per_sweep = []  # per-sweep wall seconds (for a noise-robust median)
+        seen = 0
+        t0 = time.perf_counter()
+        for ev in events:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            h.insert_event_and_run_consensus(e, set_wire_info=True)
+            if acc.sweeps != seen:
+                seen = acc.sweeps
+                per_sweep.append(acc.last_sweep_s)
+        h.flush_consensus()
+        if acc.sweeps != seen:
+            per_sweep.append(acc.last_sweep_s)
+        return h, acc, time.perf_counter() - t0, per_sweep
+
+    if warm:
+        run(False)
+        run(True)
+    h_full, acc_full, wall_full, sweeps_full = run(False)
+    h_incr, acc_incr, wall_incr, sweeps_incr = run(True)
+
+    def chain_digest(h) -> str:
+        import hashlib
+
+        d = hashlib.sha256()
+        for b in range(h.store.last_block_index() + 1):
+            blk = h.store.get_block(b)
+            d.update(
+                json.dumps(blk.body.to_dict(), default=repr,
+                           sort_keys=True).encode()
+            )
+        return d.hexdigest()[:16]
+
+    def report(acc, wall: float, per_sweep: list) -> dict:
+        sweeps = max(1, acc.sweeps)
+        stage = {
+            k: round(1e3 * v / sweeps, 3) for k, v in acc.stage_s.items()
+        }
+        snapshot = round(
+            stage.get("build", 0) + stage.get("delta_scan", 0)
+            + stage.get("pack", 0), 3,
+        )
+        med = sorted(per_sweep)[len(per_sweep) // 2] if per_sweep else 0.0
+        return {
+            "sweeps": acc.sweeps,
+            "fallbacks": acc.fallbacks,
+            "ms_per_sweep": round(
+                1e3 * acc.total_sweep_s / sweeps, 3
+            ),
+            # the steady-state number: a median is immune to the scheduler
+            # spikes a mean soaks up on shared hosts, and to the (counted,
+            # expected) rebuild sweeps
+            "median_ms_per_sweep": round(1e3 * med, 3),
+            "snapshot_ms_per_sweep": snapshot,
+            "stage_ms_per_sweep": stage,
+            "rows_delta": acc.rows_delta_total,
+            "rows_reused": acc.rows_reused_total,
+            "rebuilds": (
+                acc.window_state.rebuilds
+                if acc.window_state is not None else 0
+            ),
+            "wall_s": round(wall, 2),
+        }
+
+    full = report(acc_full, wall_full, sweeps_full)
+    incr = report(acc_incr, wall_incr, sweeps_incr)
+    match = (
+        acc_full.fallbacks == 0
+        and acc_incr.fallbacks == 0
+        and h_full.store.last_block_index() == h_incr.store.last_block_index()
+        and chain_digest(h_full) == chain_digest(h_incr)
+        and sorted(h_full.undetermined_events)
+        == sorted(h_incr.undetermined_events)
+    )
+    out = {
+        "n_peers": n_peers,
+        "n_events": n_events,
+        "chunk": chunk,
+        "full_rebuild": full,
+        "incremental": incr,
+        "consensus_match": bool(match),
+        "speedup_snapshot": (
+            round(full["snapshot_ms_per_sweep"]
+                  / incr["snapshot_ms_per_sweep"], 2)
+            if incr["snapshot_ms_per_sweep"] > 0 else None
+        ),
+        "speedup_sweep": (
+            round(full["median_ms_per_sweep"] / incr["median_ms_per_sweep"], 2)
+            if incr["median_ms_per_sweep"] > 0 else None
+        ),
+    }
     return out
 
 
@@ -784,6 +910,7 @@ def bench_ingest(n_peers: int = 8, n_events: int = 1024,
 # Keys dropped FIRST (in order) when the compact summary line would
 # exceed the driver's tail-capture budget.
 _SUMMARY_OPTIONAL_KEYS = (
+    "dagw",
     "ingest",
     "cfg3_threads_accel_txs_per_s",
     "cfg3_threads_oracle_txs_per_s",
@@ -847,9 +974,13 @@ def bench_crossover():
         h.process_decided_rounds()
         t_oracle = time.perf_counter() - t0
         # device sweep: compile (or load from the persistent cache) the
-        # window's exact shape bucket first, then measure warm
+        # window's exact shape bucket first, then measure warm.
+        # resident=False: this measures ONE-shot sweep economics, where a
+        # persistent window state has nothing to amortize and its own
+        # (headroom-bucketed) compile would pollute the warm timing —
+        # bench_dag_incremental is the resident-mode measurement.
         acc = TensorConsensus(sweep_events=10**9, async_compile=False,
-                              min_window=0, pipeline=False)
+                              min_window=0, pipeline=False, resident=False)
         hd = _replay_inserts(events, peers, acc)
         win = voting.build_voting_window(hd)
         voting.precompile(*voting.bucket_key(win))
@@ -893,7 +1024,7 @@ def _pallas_probe_inner(n_peers: int = 16, n_events: int = 1024):
     h_oracle.process_decided_rounds()
 
     acc = TensorConsensus(sweep_events=10**9, async_compile=False,
-                          min_window=0, pipeline=False)
+                          min_window=0, pipeline=False, resident=False)
     hd = _replay_inserts(events, peers, acc)
     win = voting.build_voting_window(hd)
     voting.precompile(*voting.bucket_key(win))
@@ -1318,7 +1449,44 @@ def main_smoke() -> None:
     print(line)
 
 
+def main_dag(smoke: bool = False) -> None:
+    """`make benchdag` / `make benchdagsmoke`: the dag_pipeline microbench
+    in full-rebuild vs incremental (resident) mode with the per-stage
+    breakdown on stderr and ONE parseable JSON line on stdout."""
+    if smoke:
+        # long enough that steady-state sweeps outnumber the growth-phase
+        # rebuilds, small enough for CI
+        res = bench_dag_incremental(n_peers=8, n_events=320, chunk=16)
+    else:
+        res = bench_dag_incremental()
+    for label in ("full_rebuild", "incremental"):
+        r = res[label]
+        print(
+            f"dag sweeps {label:>12}: {r['ms_per_sweep']:8.2f} ms/sweep "
+            f"(snapshot {r['snapshot_ms_per_sweep']:6.2f} ms) over "
+            f"{r['sweeps']} sweeps, rows_delta={r['rows_delta']} "
+            f"rows_reused={r['rows_reused']} rebuilds={r['rebuilds']}",
+            file=sys.stderr,
+        )
+        print(f"  stage breakdown: {r['stage_ms_per_sweep']}",
+              file=sys.stderr)
+    print(
+        f"snapshot speedup: {res['speedup_snapshot']}x, sweep speedup: "
+        f"{res['speedup_sweep']}x, consensus_match: "
+        f"{res['consensus_match']}",
+        file=sys.stderr,
+    )
+    line = json.dumps(
+        {"bench_summary": "dag_smoke" if smoke else "dag", **res},
+        separators=(",", ":"),
+    )
+    assert len(line) < 2000, "dag summary exceeded tail-capture budget"
+    print(line)
+
+
 def main() -> None:
+    if "--dag" in sys.argv:
+        return main_dag("--smoke" in sys.argv)
     if "--all" in sys.argv:
         return main_all()
     if "--smoke" in sys.argv:
@@ -1509,6 +1677,21 @@ def main() -> None:
 
     eps, dag_dt, device, dag_E, mfu, dag_err = bench_dag_pipeline_guarded()
 
+    # Incremental vs full-rebuild live sweeps (ISSUE 2): per-stage
+    # breakdown + rows_delta/rows_reused/rebuilds on the resolved device.
+    try:
+        dag_incr = _run_guarded_child("bench.bench_dag_incremental()", 420.0)
+        print(
+            f"dag incremental: full={dag_incr['full_rebuild']['ms_per_sweep']}"
+            f"ms/sweep incr={dag_incr['incremental']['ms_per_sweep']}ms/sweep "
+            f"(snapshot {dag_incr['speedup_snapshot']}x) "
+            f"match={dag_incr['consensus_match']}",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        dag_incr = {"error": f"{type(err).__name__}: {err}"}
+        print(f"dag incremental bench failed: {err}", file=sys.stderr)
+
     # Signature-verification economics on the resolved device (SURVEY §7
     # step 4a): closes the "device verify never measured on hardware" gap.
     try:
@@ -1551,6 +1734,7 @@ def main() -> None:
         "subprocess_4node": procs,
         "device_verify": device_verify,
         "ingest_fastpath": ingest,
+        "dag_incremental": dag_incr,
         "baseline_note": "reference CI liveness floor ~333 tx/s "
         "(node_test.go:536-631); reference publishes no numbers",
         "capture": "best_of_2 runs for headline + accelerated_4node "
@@ -1602,6 +1786,30 @@ def main() -> None:
                 "cfg4_churn_txs_per_s": config4.get("txs_per_s"),
                 "cfg5_adversarial_txs_per_s": config5.get("txs_per_s"),
                 "ingest": ingest,
+                # Incremental-window digest (ISSUE 2): per-sweep cost in
+                # both modes, the incremental arm's stage breakdown, and
+                # the rows_delta/rows_reused/rebuilds counters.
+                "dagw": (
+                    {
+                        "full_ms": dag_incr["full_rebuild"]["ms_per_sweep"],
+                        "incr_ms": dag_incr["incremental"]["ms_per_sweep"],
+                        "snap_full_ms": dag_incr["full_rebuild"][
+                            "snapshot_ms_per_sweep"
+                        ],
+                        "snap_incr_ms": dag_incr["incremental"][
+                            "snapshot_ms_per_sweep"
+                        ],
+                        "stage_ms": dag_incr["incremental"][
+                            "stage_ms_per_sweep"
+                        ],
+                        "rows_delta": dag_incr["incremental"]["rows_delta"],
+                        "rows_reused": dag_incr["incremental"]["rows_reused"],
+                        "rebuilds": dag_incr["incremental"]["rebuilds"],
+                        "match": dag_incr["consensus_match"],
+                    }
+                    if "error" not in dag_incr
+                    else dag_incr
+                ),
             }
         )
     )

@@ -34,9 +34,19 @@ bench:
 benchsmoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke | tail -n 1 | python -c "import json,sys; line=sys.stdin.read().strip(); d=json.loads(line); assert 'committed_txs_per_s_4node' in d, 'summary missing headline metric'; assert len(line) < 2000, 'summary too long'; print('benchsmoke ok:', d['committed_txs_per_s_4node'], 'tx/s')"
 
+# benchdag: dag_pipeline microbench, full-rebuild vs incremental
+# (device-resident) voting windows, with the per-stage sweep breakdown
+benchdag:
+	JAX_PLATFORMS=cpu python bench.py --dag
+
+# benchdagsmoke: small CI variant; asserts the JSON digest parses, both
+# arms reached identical consensus, and the stage breakdown is present
+benchdagsmoke:
+	JAX_PLATFORMS=cpu python bench.py --dag --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d.get('consensus_match') is True, d; assert d['incremental']['stage_ms_per_sweep'], d; print('benchdagsmoke ok: snapshot', str(d['speedup_snapshot']) + 'x,', 'rebuilds', d['incremental']['rebuilds'])"
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke wheel
