@@ -1,0 +1,34 @@
+"""Jittered exponential backoff, shared by every retry site.
+
+One formula so the selector's per-peer backoff, the joining node's retry
+sleep, and the fast-forward poll loop all behave identically:
+
+    delay = min(cap_s, base_s * 2^(attempt-1) * (1 + jitter * u))
+
+with u drawn uniform from [-1, 1]. Jitter multiplies BEFORE the cap, so
+``cap_s`` is a hard bound — a configured 2 s cap never sleeps 2.5 s.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+def jittered_backoff(
+    attempt: int,
+    base_s: float,
+    cap_s: float,
+    jitter: float = 0.25,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Backoff for the ``attempt``-th consecutive failure (1-based).
+    The exponent is clamped: a permanently dead peer accrues failures
+    forever, and an unclamped 2**n overflows float after ~1000 of them
+    (the cap has long since dominated anyway)."""
+    if attempt < 1:
+        return 0.0
+    u = (rng.uniform(-1.0, 1.0) if rng is not None
+         else random.uniform(-1.0, 1.0))
+    nominal = base_s * (2.0 ** min(attempt - 1, 32))
+    return min(cap_s, nominal * (1.0 + jitter * u))

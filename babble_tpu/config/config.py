@@ -45,6 +45,13 @@ class Config:
     sync_limit: int = 1000
     suspend_limit: int = 100
 
+    # Resilience knobs (docs/robustness.md): total budget for the
+    # catching-up node's fast-forward poll loop (each pass polls every
+    # peer; transient failures retry with exponential backoff until the
+    # deadline), and the cap on the joining node's retry backoff.
+    fast_forward_deadline: float = 5.0
+    join_backoff_cap: float = 2.0
+
     # Signal/relay mode (the reference's WebRTC+WAMP analogue,
     # config/config.go:163-187): nodes keep one outbound connection to a
     # rendezvous server and are addressed by public key, so NAT-ed nodes

@@ -34,9 +34,26 @@ import threading
 import time
 from typing import Optional
 
+from babble_tpu.common.breaker import CircuitBreaker
 from babble_tpu.common.errors import StoreError
 
 logger = logging.getLogger("babble_tpu.hashgraph.accel")
+
+
+def _breaker_from_env() -> CircuitBreaker:
+    """Device-path circuit breaker with env-tunable parameters: open after
+    BABBLE_ACCEL_BREAKER_N failures within BABBLE_ACCEL_BREAKER_WINDOW_S
+    seconds, refuse the device for BABBLE_ACCEL_BREAKER_COOLDOWN_S, then
+    probe one sweep to half-open/re-close."""
+    import os
+
+    return CircuitBreaker(
+        threshold=max(1, int(os.environ.get("BABBLE_ACCEL_BREAKER_N", "5"))),
+        window_s=float(os.environ.get("BABBLE_ACCEL_BREAKER_WINDOW_S", "30")),
+        cooldown_s=float(
+            os.environ.get("BABBLE_ACCEL_BREAKER_COOLDOWN_S", "15")
+        ),
+    )
 
 
 class _Inflight:
@@ -139,6 +156,17 @@ class _FlockSlots:
         os.close(fd)
 
 
+def _is_stale_window(err: BaseException) -> bool:
+    """True for the batcher's stale-generation rejection — the window
+    snapshot aged out before dispatch, which says nothing about device
+    health (the breaker must not count it as a failure)."""
+    try:
+        from babble_tpu.ops.window_state import StaleWindowError
+    except Exception:
+        return False
+    return isinstance(err, StaleWindowError)
+
+
 _INFLIGHT_SLOTS = None
 _slots_lock = threading.Lock()
 
@@ -165,7 +193,8 @@ class TensorConsensus:
                  pipeline: bool | None = None,
                  mesh=None,
                  batcher: bool | None = None,
-                 resident: bool | None = None):
+                 resident: bool | None = None,
+                 breaker: CircuitBreaker | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -209,6 +238,14 @@ class TensorConsensus:
         # vmapped batch program cannot donate per-node buffers).
         self.resident = resident
         self.window_state = None
+        # Device-path circuit breaker: transient failures fall back to the
+        # oracle per-flush as before, but a FLAPPING device (N failures in
+        # a window) opens the breaker and the node stops paying for device
+        # dispatch attempts for a cooldown; a probe sweep then re-enables
+        # the path once the device answers again. This replaces any notion
+        # of a sticky "disable forever" kill-switch: degradation is always
+        # recoverable.
+        self.breaker = breaker if breaker is not None else _breaker_from_env()
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0
@@ -277,6 +314,10 @@ class TensorConsensus:
         inf = self._inflight
         if inf is not None:
             inf.release_slot()
+            # the dropped sweep never reports an outcome; if it was the
+            # half-open probe, release the probe slot so the breaker can
+            # admit another
+            self.breaker.cancel()
         self._inflight = None
         self._last_snapshot_topo = -1
         if self.window_state is not None:
@@ -423,6 +464,10 @@ class TensorConsensus:
         if not self.pipeline:
             if not self.use_device(len(hg.undetermined_events)):
                 return False
+            if not self.breaker.allow():
+                # breaker open: the device is known-bad; don't pay for a
+                # dispatch attempt, let the oracle carry the flush
+                return False
             return self.sweep(hg)
 
         handled = False
@@ -468,6 +513,8 @@ class TensorConsensus:
         if hg.topological_index != self._last_snapshot_topo and self.use_device(
             len(hg.undetermined_events)
         ):
+            if not self.breaker.allow():
+                return handled  # breaker open: oracle unless already applied
             launched = self._launch(hg)
             return handled or launched
         return handled
@@ -575,12 +622,14 @@ class TensorConsensus:
         try:
             win, snap = self._snapshot(hg, for_batcher=bool(self.batcher))
             if win is None:
+                self.breaker.cancel()  # no device attempt to judge
                 return True  # nothing undecided
             if not self._bucket_ready(win):
                 if snap is not None:
                     # the snapshot's delta is committed to the mirrors but
                     # never reached the device — reseed residency later
                     self.window_state.drop_residency()
+                self.breaker.cancel()
                 return False
         except Exception as err:
             self._note_fallback(err)
@@ -599,6 +648,7 @@ class TensorConsensus:
                 self.contended += 1
                 if snap is not None:
                     self.window_state.drop_residency()
+                self.breaker.cancel()
                 return False
             inf = _Inflight(win, self.generation, hg.topological_index,
                             None, snap)
@@ -642,6 +692,7 @@ class TensorConsensus:
             self.contended += 1
             if snap is not None:
                 self.window_state.drop_residency()
+            self.breaker.cancel()
             return False
         inf = _Inflight(win, self.generation, hg.topological_index, slots,
                         snap)
@@ -678,6 +729,12 @@ class TensorConsensus:
 
         t0 = time.perf_counter()
         if inf.error is not None:
+            if _is_stale_window(inf.error):
+                # batcher rejected an aged-out window: neutral outcome,
+                # same handling as the snap-generation check below
+                self.stale_drops += 1
+                self.breaker.cancel()
+                return False
             self._note_fallback(inf.error)
             return False
         state = self.window_state
@@ -690,6 +747,7 @@ class TensorConsensus:
             # them — the oracle carries this flush and the dirty state
             # rebuilds at the next snapshot.
             self.stale_drops += 1
+            self.breaker.cancel()  # not the device's fault: no verdict
             return False
         try:
             fame, rr = inf.result
@@ -705,6 +763,7 @@ class TensorConsensus:
         self.stage_s["apply"] += t_apply
         self.stage_s["kernel"] += kernel_s
         self.stage_s["readback"] += inf.readback_s
+        self.breaker.record_success()
         self.sweeps += 1
         self.last_window_events = len(inf.win.hashes)
         # Sweep cost, not launch-to-apply wall time (the latter includes
@@ -725,8 +784,10 @@ class TensorConsensus:
         try:
             win, snap = self._snapshot(hg, for_batcher=bool(self.batcher))
             if win is None:
+                self.breaker.cancel()  # no device attempt to judge
                 return True  # nothing undecided
             if not self._bucket_ready(win):
+                self.breaker.cancel()
                 return False
             t1 = time.perf_counter()
             if self.batcher:
@@ -738,6 +799,7 @@ class TensorConsensus:
                 ticket = SweepBatcher.instance().submit(win)
                 if ticket is None:
                     self.contended += 1
+                    self.breaker.cancel()
                     return False
                 self.stage_s["dispatch"] += time.perf_counter() - t1
                 t_r = time.perf_counter()
@@ -763,8 +825,13 @@ class TensorConsensus:
                 self.window_state.note_applied(fame_applied, received)
             self.stage_s["apply"] += time.perf_counter() - t2
         except Exception as err:
+            if _is_stale_window(err):
+                self.stale_drops += 1
+                self.breaker.cancel()
+                return False
             self._note_fallback(err)
             return False
+        self.breaker.record_success()
         self.sweeps += 1
         self.last_window_events = len(win.hashes)
         self.last_sweep_s = time.perf_counter() - t0
@@ -777,6 +844,10 @@ class TensorConsensus:
         # are ordered so no partial mutation precedes a fallible read (see
         # apply_round_received), making the oracle re-run safe.
         self.fallbacks += 1
+        # feed the circuit breaker: N of these within its window open it,
+        # and the node stops paying for device attempts until a cooldown
+        # probe succeeds (state machine in common/breaker.py)
+        self.breaker.record_failure()
         if self.window_state is not None:
             # the oracle pass that follows mutates state the mirrors can't
             # track; the next snapshot must rebuild
@@ -844,6 +915,9 @@ class TensorConsensus:
             ),
             "accel_stale_drops": self.stale_drops,
         }
+        # circuit-breaker surface: accel_breaker_state/open/probes/skips/
+        # failures (open = count of closed→open transitions)
+        out.update(self.breaker.stats(prefix="accel_breaker_"))
         if self.batcher:
             from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
 

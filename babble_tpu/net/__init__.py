@@ -12,9 +12,16 @@ from .rpc import (
     SyncRequest,
     SyncResponse,
 )
-from .transport import Transport, TransportError
+from .transport import RemoteError, Transport, TransportError
 from .inmem import InmemNetwork, InmemTransport
 from .tcp import TCPTransport
+from .chaos import (
+    ChaosController,
+    ChaosTransport,
+    LinkFaults,
+    Nemesis,
+    NemesisStep,
+)
 
 __all__ = [
     "RPC",
@@ -28,7 +35,13 @@ __all__ = [
     "JoinResponse",
     "Transport",
     "TransportError",
+    "RemoteError",
     "InmemNetwork",
     "InmemTransport",
     "TCPTransport",
+    "ChaosController",
+    "ChaosTransport",
+    "LinkFaults",
+    "Nemesis",
+    "NemesisStep",
 ]

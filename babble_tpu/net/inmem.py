@@ -25,7 +25,7 @@ from .rpc import (
     SyncRequest,
     SyncResponse,
 )
-from .transport import TransportError
+from .transport import RemoteError, TransportError
 
 _counter = itertools.count()
 
@@ -78,7 +78,7 @@ class InmemNetwork:
         except queue.Empty:
             raise TransportError(f"rpc timeout to {target}")
         if error:
-            raise TransportError(error)
+            raise RemoteError(error)
         return result
 
 

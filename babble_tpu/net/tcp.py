@@ -21,7 +21,7 @@ import queue
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..crypto.canonical import canonical_dumps
 from .rpc import (
@@ -31,12 +31,18 @@ from .rpc import (
     RPC,
     TYPE_OF_REQUEST,
 )
-from .transport import TransportError
+from .transport import RemoteError, TransportError
 
 
 # Upper bound on any frame (request or response). A hostile peer could
 # otherwise send a 4 GB length prefix and make the receiver allocate it.
 MAX_FRAME = 64 * 1024 * 1024
+
+
+class _ConnError(TransportError):
+    """Connection-level failure (socket died mid-RPC) — retryable on a
+    fresh dial, unlike a remote handler error, which means the peer
+    received, processed, and answered the request."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,10 +72,17 @@ class TCPTransport:
         max_pool: int = 3,
         timeout: float = 10.0,
         join_timeout: Optional[float] = None,
+        dial_timeout: Optional[float] = None,
     ):
         self._bind_addr = bind_addr
         self._advertise = advertise_addr or bind_addr
         self._timeout = timeout
+        # Dial (connect) deadline, separate from the RPC deadline: a dead
+        # host should fail the dial in seconds, not hold a gossip round
+        # for the full RPC timeout.
+        self._dial_timeout = (
+            dial_timeout if dial_timeout is not None else min(timeout, 3.0)
+        )
         # Join/leave RPCs block on consensus server-side, so they get their
         # own, much longer deadline (reference keeps these separate:
         # node_rpc.go join waits JoinTimeout while syncs use TCPTimeout).
@@ -83,6 +96,10 @@ class TCPTransport:
         self._pool_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Pool-hardening counters: stale pooled sockets evicted mid-RPC,
+        # and RPCs salvaged by the one fresh-dial retry.
+        self.pool_evictions = 0
+        self.retries = 0
 
     # -- Transport interface -------------------------------------------------
 
@@ -192,20 +209,38 @@ class TCPTransport:
 
     # -- client side ---------------------------------------------------------
 
-    def _checkout(self, target: str) -> socket.socket:
+    def _checkout(self, target: str) -> Tuple[socket.socket, bool]:
+        """A connection to ``target``: (socket, came_from_pool)."""
         with self._pool_lock:
             conns = self._pool.get(target)
             if conns:
-                return conns.pop()
+                return conns.pop(), True
+        return self._dial(target), False
+
+    def _dial(self, target: str) -> socket.socket:
         host, port_s = target.rsplit(":", 1)
         try:
             sock = socket.create_connection(
-                (host, int(port_s)), timeout=self._timeout
+                (host, int(port_s)), timeout=self._dial_timeout
             )
         except OSError as err:
             raise TransportError(f"dial {target}: {err}") from err
         sock.settimeout(self._timeout)
         return sock
+
+    def _evict_pool(self, target: str) -> None:
+        """A pooled socket to ``target`` just failed mid-RPC; its pool
+        siblings were checked in around the same time and are almost
+        certainly stale too (peer restarted) — close them all rather than
+        paying one failed RPC per corpse."""
+        with self._pool_lock:
+            conns = self._pool.pop(target, [])
+            self.pool_evictions += 1 + len(conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _checkin(self, target: str, sock: socket.socket) -> None:
         sock.settimeout(self._timeout)  # undo any per-request deadline
@@ -220,23 +255,57 @@ class TCPTransport:
             pass
 
     def _request(self, target: str, req, timeout: Optional[float] = None):
+        """One RPC. A failure on a POOLED socket is most often a stale
+        connection (the peer restarted between RPCs), not a dead peer:
+        evict the target's pool and retry ONCE on a fresh dial before
+        surfacing TransportError. Handlers are idempotent (hashgraph
+        inserts dedupe), so the at-most-one duplicate delivery a retry
+        can cause is safe. Fresh-dial failures surface immediately."""
         type_byte = TYPE_OF_REQUEST[type(req)]
-        sock = self._checkout(target)
+        sock, pooled = self._checkout(target)
+        try:
+            return self._roundtrip(target, sock, type_byte, req, timeout)
+        except _ConnError:
+            if not pooled:
+                raise
+            self._evict_pool(target)
+            self.retries += 1
+            sock = self._dial(target)
+            return self._roundtrip(target, sock, type_byte, req, timeout)
+
+    def _roundtrip(
+        self,
+        target: str,
+        sock: socket.socket,
+        type_byte: int,
+        req,
+        timeout: Optional[float],
+    ):
         try:
             if timeout is not None:
                 sock.settimeout(timeout)
             _send_frame(sock, type_byte, canonical_dumps(req.to_dict()))
             (length,) = struct.unpack(">I", _recv_exact(sock, 4))
             body = json.loads(_recv_exact(sock, length))
-        except (OSError, ConnectionError, struct.error, ValueError) as err:
+        except socket.timeout as err:
+            # A timeout means the peer is slow or gone, NOT that the pooled
+            # socket was stale — retrying would double the worst-case RPC
+            # latency and deliver the request twice to a slow-but-alive
+            # peer. Surface it as non-retryable.
             try:
                 sock.close()
             except OSError:
                 pass
             raise TransportError(f"rpc to {target}: {err}") from err
+        except (OSError, ConnectionError, struct.error, ValueError) as err:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise _ConnError(f"rpc to {target}: {err}") from err
         self._checkin(target, sock)
         if body.get("error"):
-            raise TransportError(f"remote error from {target}: {body['error']}")
+            raise RemoteError(f"remote error from {target}: {body['error']}")
         resp_cls = RESPONSE_TYPES[type_byte]
         return resp_cls.from_dict(body["payload"])
 

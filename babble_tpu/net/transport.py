@@ -27,6 +27,13 @@ class TransportError(Exception):
     """Raised when an RPC fails (connection refused, timeout, remote error)."""
 
 
+class RemoteError(TransportError):
+    """The peer RECEIVED the request and answered with an error. The
+    network worked; retrying the transport cannot help — callers deciding
+    whether to retry (fast-forward's poll loop) treat this as a
+    conclusive answer, not a connectivity failure."""
+
+
 class Transport(Protocol):
     """reference: net/transport.go:5-35."""
 

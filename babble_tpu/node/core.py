@@ -148,9 +148,13 @@ class Core:
         self.hg.bootstrap()
 
     def set_peers(self, ps: PeerSet) -> None:
-        """reference: core.go:185-188."""
+        """reference: core.go:185-188. ``prior`` carries the surviving
+        peers' health scores and backoff state across the rebuild, so a
+        membership change doesn't amnesty every failing peer."""
         self.peers = ps
-        self.peer_selector = RandomPeerSelector(ps, self.validator.id())
+        self.peer_selector = RandomPeerSelector(
+            ps, self.validator.id(), prior=self.peer_selector
+        )
 
     # -- busy ---------------------------------------------------------------
 

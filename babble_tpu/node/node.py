@@ -36,7 +36,7 @@ from ..net.rpc import (
     SyncRequest,
     SyncResponse,
 )
-from ..net.transport import Transport, TransportError
+from ..net.transport import RemoteError, Transport, TransportError
 from ..peers.peer import Peer
 from ..peers.peer_set import PeerSet
 from ..common.latency import LatencyRecorder
@@ -89,6 +89,19 @@ class Node(StateManager):
         self.start_time = 0.0
         self.sync_requests = 0
         self.sync_errors = 0
+        # Per-RPC-type handler error counters (surfaced as rpc_errors_* in
+        # get_stats): chaos runs use these to tell "request dropped by the
+        # nemesis" (no counter moves) from "handler crashed" (it does).
+        self.rpc_errors: Dict[str, int] = {
+            "sync": 0,
+            "eager_sync": 0,
+            "fast_forward": 0,
+            "join": 0,
+        }
+        # Joining-state backoff: consecutive join failures grow the retry
+        # sleep exponentially (capped by conf.join_backoff_cap) so a node
+        # stuck outside a partitioned cluster doesn't hammer dead peers.
+        self._join_failures = 0
         # Gossip-leg durations, served at /debug/timers (the reference logs
         # the same ns durations per round, node.go:511-514,543-548,593-608).
         self.timers = LatencyRecorder()
@@ -321,6 +334,14 @@ class Node(StateManager):
                 "norm_cache_misses": str(NORM_CACHE.misses),
             }
         )
+        # Robustness surface: handler crash counters per RPC type, and the
+        # peer selector's health/backoff view of the network.
+        stats.update(
+            {f"rpc_errors_{k}": str(v) for k, v in self.rpc_errors.items()}
+        )
+        stats.update(
+            {k: str(v) for k, v in self.core.peer_selector.stats().items()}
+        )
         accel = self.core.hg.accel
         if accel is not None:
             stats.update({k: str(v) for k, v in accel.stats().items()})
@@ -431,17 +452,23 @@ class Node(StateManager):
     def _gossip(self, peer: Peer) -> None:
         """Pull-push gossip round (reference: node.go:466-501)."""
         connected = False
+        transport_failure = False
         try:
             other_known = self._pull(peer)
             self._push(peer, other_known)
             connected = True
             self._log_stats()
         except TransportError as err:
+            transport_failure = True
             self.logger.debug("gossip transport error: %s", err)
         except Exception as err:
             self.logger.warning("gossip error: %s", err)
         finally:
-            self.core.peer_selector.update_last(peer.id, connected)
+            # only NETWORK failures decay the peer's health/backoff; a
+            # local error (the generic branch) isn't the peer's fault
+            self.core.peer_selector.update_last(
+                peer.id, connected, penalize=transport_failure
+            )
 
     def _pull(self, peer: Peer) -> Dict[int, int]:
         """SyncRequest leg (reference: node.go:504-538)."""
@@ -520,21 +547,48 @@ class Node(StateManager):
         self._transition(State.BABBLING)
 
     def _get_best_fast_forward_response(self) -> Optional[FastForwardResponse]:
-        """Poll all peers, keep the highest block (reference: node.go:670-701)."""
-        best: Optional[FastForwardResponse] = None
-        max_block = 0
-        for p in self.core.peer_selector.get_peers().peers:
-            if p.id == self.get_id():
-                continue
-            try:
-                resp = self._request_fast_forward(p.net_addr)
-            except TransportError as err:
-                self.logger.debug("requestFastForward(%s): %s", p.net_addr, err)
-                continue
-            if resp.block is not None and resp.block.index() > max_block:
-                best = resp
-                max_block = resp.block.index()
-        return best
+        """Poll all peers, keep the highest block (reference: node.go:670-701).
+
+        A catching-up node on a flaky network must not give up because ONE
+        poll pass hit transport errors: passes retry with exponential
+        backoff (jittered) until conf.fast_forward_deadline. A pass where
+        every peer ANSWERED (a response or a RemoteError — e.g. "no
+        anchor block" in a young cluster) is conclusive — no retry — as
+        is a cluster with no other peers. Only connectivity failures,
+        which retrying can heal, re-poll."""
+        from ..common.backoff import jittered_backoff
+
+        deadline = time.monotonic() + self.conf.fast_forward_deadline
+        attempt = 0
+        while True:
+            best: Optional[FastForwardResponse] = None
+            max_block = 0
+            transport_errors = 0
+            for p in self.core.peer_selector.get_peers().peers:
+                if p.id == self.get_id():
+                    continue
+                try:
+                    resp = self._request_fast_forward(p.net_addr)
+                except TransportError as err:
+                    if not isinstance(err, RemoteError):
+                        transport_errors += 1
+                    self.logger.debug(
+                        "requestFastForward(%s): %s", p.net_addr, err
+                    )
+                    continue
+                if resp.block is not None and resp.block.index() > max_block:
+                    best = resp
+                    max_block = resp.block.index()
+            if best is not None or transport_errors == 0:
+                return best
+            attempt += 1
+            delay = jittered_backoff(attempt, 0.1, 1.0)
+            if (
+                time.monotonic() + delay > deadline
+                or self.shutdown_event.is_set()
+            ):
+                return None
+            time.sleep(delay)
 
     # -- joining ------------------------------------------------------------
 
@@ -551,9 +605,22 @@ class Node(StateManager):
             resp = self._request_join(peer.net_addr)
         except TransportError as err:
             self.logger.warning("cannot join via %s: %s", peer.net_addr, err)
-            time.sleep(0.2)
+            # feed the selector so the next attempt prefers another peer,
+            # and back off exponentially (jittered, capped) — the run loop
+            # re-enters _join, so the sleep here IS the retry cadence
+            from ..common.backoff import jittered_backoff
+
+            self.core.peer_selector.update_last(peer.id, False)
+            self._join_failures += 1
+            time.sleep(
+                jittered_backoff(
+                    self._join_failures, 0.2, self.conf.join_backoff_cap
+                )
+            )
             return
 
+        self._join_failures = 0
+        self.core.peer_selector.update_last(peer.id, True)
         if resp.accepted:
             self.core.accepted_round = resp.accepted_round
             self.core.removed_round = -1
@@ -631,6 +698,8 @@ class Node(StateManager):
                 resp.known = self.core.known_events()
         except Exception as e:
             self.sync_errors += 1
+            self.rpc_errors["sync"] += 1
+            self.logger.debug("sync handler error: %s", e, exc_info=True)
             err = str(e)
         rpc.respond(resp, err)
 
@@ -646,6 +715,10 @@ class Node(StateManager):
                 self._sync(cmd.from_id, cmd.events, prepared)
         except Exception as e:
             success = False
+            self.rpc_errors["eager_sync"] += 1
+            self.logger.debug(
+                "eager-sync handler error: %s", e, exc_info=True
+            )
             err = str(e)
         rpc.respond(EagerSyncResponse(self.get_id(), success), err)
 
@@ -662,6 +735,10 @@ class Node(StateManager):
             resp.frame = frame
             resp.snapshot = self.proxy.get_snapshot(block.index())
         except Exception as e:
+            self.rpc_errors["fast_forward"] += 1
+            self.logger.debug(
+                "fast-forward handler error: %s", e, exc_info=True
+            )
             err = str(e)
         rpc.respond(resp, err)
 
@@ -691,7 +768,9 @@ class Node(StateManager):
                 peers = presp.peers
             except queue.Empty:
                 err = "timeout waiting for join request to reach consensus"
-
+        if err is not None:
+            self.rpc_errors["join"] += 1
+            self.logger.debug("join handler error: %s", err)
         rpc.respond(
             JoinResponse(self.get_id(), accepted, accepted_round, peers), err
         )

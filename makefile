@@ -44,9 +44,21 @@ benchdag:
 benchdagsmoke:
 	JAX_PLATFORMS=cpu python bench.py --dag --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d.get('consensus_match') is True, d; assert d['incremental']['stage_ms_per_sweep'], d; print('benchdagsmoke ok: snapshot', str(d['speedup_snapshot']) + 'x,', 'rebuilds', d['incremental']['rebuilds'])"
 
+# chaossmoke: short-budget nemesis soak — 10% drop + duplication +
+# partition/heal on a 5-node in-mem cluster, plus the bounded
+# shutdown/leave-under-partition checks; deterministic under
+# BABBLE_CHAOS_SEED (docs/robustness.md). The full nemesis storm
+# (flapper + slow peer, more rounds) stays behind -m slow.
+chaossmoke:
+	JAX_PLATFORMS=cpu BABBLE_CHAOS_SEED=42 python -m pytest tests/test_chaos.py -q -m "chaos and not slow"
+
+# chaossoak: the long storm, seed overridable for exploratory runs
+chaossoak:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m "chaos"
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke chaossmoke chaossoak wheel

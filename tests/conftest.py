@@ -43,6 +43,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process end-to-end scenarios"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection soaks (deterministic under "
+        "BABBLE_CHAOS_SEED; short ones run in tier-1 / make chaossmoke, "
+        "the long nemesis storm is also marked slow)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,

@@ -1,0 +1,512 @@
+"""Chaos suite: gossip under injected faults (babble_tpu.net.chaos).
+
+Unit tests pin the ChaosTransport fault semantics against a scripted
+inner transport; the soak tests run a real in-mem cluster under a seeded
+nemesis schedule (drop + duplication + partition/heal) and assert the
+three properties ISSUE-3 demands:
+
+- **liveness after heal**: new blocks commit once the partition lifts;
+- **safety**: every node holds byte-identical block bodies — faults may
+  slow consensus but must never fork it;
+- **bounded queues**: consumer queues don't grow without bound while the
+  nemesis runs.
+
+Deterministic under BABBLE_CHAOS_SEED (default 42): each directed link
+draws its faults from its own seeded stream, so thread interleaving on
+other links never perturbs a link's drop/dup sequence.
+
+The short soak carries the ``chaos`` marker and runs in tier-1 /
+``make chaossmoke``; the long soak (more rounds, a flapper, a slow peer)
+stays ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.chaos import (
+    ChaosController,
+    ChaosTransport,
+    LinkFaults,
+    Nemesis,
+    NemesisStep,
+    flapper,
+    partition_heal_cycle,
+    seed_from_env,
+    slow_peer_window,
+)
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.net.rpc import (
+    RPC,
+    EagerSyncRequest,
+    SyncRequest,
+    SyncResponse,
+)
+from babble_tpu.net.transport import TransportError
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+
+# -- unit: fault semantics over a scripted inner transport ----------------
+
+
+class _ScriptedTransport:
+    """Counts deliveries; advertise_addr fixed. Stands in for a real
+    transport on the CLIENT side of a ChaosTransport."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.calls: List[str] = []
+        self._lock = threading.Lock()
+
+    def advertise_addr(self) -> str:
+        return self.addr
+
+    def local_addr(self) -> str:
+        return self.addr
+
+    def consumer(self):
+        return None
+
+    def listen(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def sync(self, target, req):
+        with self._lock:
+            self.calls.append(target)
+        return SyncResponse(from_id=1)
+
+    eager_sync = fast_forward = join = sync
+
+
+def _chaos_pair(**ctl_kwargs):
+    ctl = ChaosController(seed=7, drop_hold_s=0.01, **ctl_kwargs)
+    inner = _ScriptedTransport("a")
+    return ChaosTransport(inner, ctl), inner, ctl
+
+
+def test_partition_blocks_forward_and_response():
+    t, inner, ctl = _chaos_pair()
+    req = SyncRequest(from_id=1, known={}, sync_limit=10)
+    assert t.sync("b", req).from_id == 1  # healthy link delivers
+
+    ctl.partition([["a"], ["b"]])
+    with pytest.raises(TransportError, match="blocked by partition"):
+        t.sync("b", req)
+    # forward-blocked: the request never reached the peer
+    assert inner.calls == ["b"]
+
+    ctl.heal()
+    ctl.partition_oneway("b", "a")  # reverse path only
+    with pytest.raises(TransportError, match="response .* blocked"):
+        t.sync("b", req)
+    # one-way reverse block: the peer DID process the request
+    assert inner.calls == ["b", "b"]
+
+
+def test_drop_and_corrupt_raise_without_delivery():
+    t, inner, ctl = _chaos_pair(default_faults=LinkFaults(drop=1.0))
+    with pytest.raises(TransportError, match="dropped"):
+        t.sync("b", SyncRequest(from_id=1, known={}, sync_limit=10))
+    assert inner.calls == []
+    assert ctl.drops == 1
+
+    t, inner, ctl = _chaos_pair(default_faults=LinkFaults(corrupt=1.0))
+    with pytest.raises(TransportError, match="corrupted"):
+        t.sync("b", SyncRequest(from_id=1, known={}, sync_limit=10))
+    assert inner.calls == []
+    assert ctl.corrupts == 1
+
+
+def test_duplicate_delivers_twice():
+    t, inner, ctl = _chaos_pair(default_faults=LinkFaults(duplicate=1.0))
+    got = t.sync("b", SyncRequest(from_id=1, known={}, sync_limit=10))
+    assert got.from_id == 1
+    deadline = time.monotonic() + 2.0
+    while len(inner.calls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(inner.calls) == 2, "duplicate delivery never landed"
+    assert ctl.duplicates == 1
+
+
+def test_link_faults_deterministic_per_seed():
+    """Same seed ⇒ same per-link fault sequence, independent of other
+    links' draws."""
+
+    def outcomes(seed):
+        ctl = ChaosController(
+            seed=seed, default_faults=LinkFaults(drop=0.5), drop_hold_s=0.0
+        )
+        return [ctl.plan("a", "b").drop for _ in range(32)]
+
+    assert outcomes(11) == outcomes(11)
+    assert outcomes(11) != outcomes(12)  # astronomically unlikely to match
+
+    # draws on another link must not perturb this link's stream
+    ctl = ChaosController(
+        seed=11, default_faults=LinkFaults(drop=0.5), drop_hold_s=0.0
+    )
+    mixed = []
+    for _ in range(32):
+        ctl.plan("x", "y")
+        mixed.append(ctl.plan("a", "b").drop)
+    assert mixed == outcomes(11)
+
+
+def test_partition_preserves_isolate_blocks():
+    """A partition() step firing mid-flap must not heal an isolate()d
+    peer (flapper + partition_heal_cycle schedules can interleave)."""
+    ctl = ChaosController(seed=5)
+    ctl.isolate("c", ["a", "b"])
+    ctl.partition([["a", "c"], ["b"]])  # c grouped WITH a — still down
+    assert ctl.plan("a", "c").blocked_forward
+    assert ctl.plan("c", "a").blocked_forward
+    ctl.heal()
+    assert not ctl.plan("a", "c").blocked_forward
+
+
+def test_flapper_heals_only_its_own_links():
+    """A flapper's up-transition must not lift a concurrent group
+    partition — it heals only the flapped peer's links."""
+    ctl = ChaosController(seed=9)
+    ctl.partition([["a", "b"], ["c"]])
+    steps = flapper("b", ["a", "c"], first_at=0.0, down_for=0.0,
+                    up_for=0.0, rounds=1)
+    for s in steps:
+        getattr(ctl, s.op)(**s.kwargs)
+    # b's links are restored...
+    assert not ctl.plan("a", "b").blocked_forward
+    # ...but the a|c group partition still stands
+    assert ctl.plan("a", "c").blocked_forward
+    assert ctl.plan("c", "a").blocked_forward
+
+
+def test_nemesis_rejects_unknown_op_and_survives_step_errors():
+    ctl = ChaosController(seed=9)
+    with pytest.raises(ValueError, match="unknown nemesis op"):
+        Nemesis(ctl, [NemesisStep(0.0, "partitionn", {})])
+
+    # a step raising mid-storm (bad kwargs) is recorded and the schedule
+    # CONTINUES — the trailing heal must still run
+    ctl.partition([["a"], ["b"]])
+    nem = Nemesis(ctl, [
+        NemesisStep(0.0, "isolate", {}),  # TypeError: missing args
+        NemesisStep(0.01, "heal", {}),
+    ]).start()
+    assert nem.wait(5.0)
+    assert len(nem.errors) == 1 and "isolate" in nem.errors[0]
+    assert [e.split(":")[1] for e in nem.executed] == ["heal"]
+    assert not ctl.plan("a", "b").blocked_forward
+
+
+def test_nemesis_runs_schedule_in_order():
+    ctl = ChaosController(seed=3)
+    steps = partition_heal_cycle(
+        [["a"], ["b"]], first_at=0.0, partition_for=0.1, heal_for=0.05,
+        rounds=2,
+    ) + slow_peer_window("a", at=0.35, duration=0.1, delay_min_s=0.01,
+                         delay_max_s=0.02)
+    nem = Nemesis(ctl, steps).start()
+    assert nem.wait(5.0)
+    assert [e.split(":")[1] for e in nem.executed] == [
+        "partition", "heal", "partition", "heal", "slow_peer", "clear_slow",
+    ]
+    assert not ctl.plan("a", "b").blocked_forward  # healed at the end
+
+
+# -- cluster harness ------------------------------------------------------
+
+
+def make_chaos_cluster(
+    n: int,
+    controller: ChaosController,
+    heartbeat: float = 0.02,
+    join_timeout: float = 2.0,
+):
+    """n in-mem nodes whose outbound RPCs all ride one ChaosController."""
+    network = InmemNetwork()
+    keys = [generate_key() for _ in range(n)]
+    peers = PeerSet(
+        [
+            Peer(f"inmem://node{i}", k.public_key.hex(), f"node{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    addr_of = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    nodes: List[Node] = []
+    proxies: List[InmemProxy] = []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=heartbeat,
+            slow_heartbeat_timeout=0.2,
+            moniker=f"node{i}",
+            log_level="warning",
+            join_timeout=join_timeout,
+        )
+        trans = ChaosTransport(
+            network.new_transport(addr_of[k.public_key.hex()]), controller
+        )
+        proxy = InmemProxy(DummyState())
+        node = Node(
+            conf, Validator(k, f"node{i}"), peers, peers,
+            InmemStore(conf.cache_size), trans, proxy,
+        )
+        node.init()
+        nodes.append(node)
+        proxies.append(proxy)
+    return nodes, proxies
+
+
+def _bombard_until(nodes, proxies, target_block: int, timeout: float):
+    deadline = time.monotonic() + timeout
+    i = 0
+    while time.monotonic() < deadline:
+        proxies[i % len(proxies)].submit_tx(f"chaos tx {i}".encode())
+        i += 1
+        if all(n.get_last_block_index() >= target_block for n in nodes):
+            return
+        time.sleep(0.01)
+    indexes = [n.get_last_block_index() for n in nodes]
+    pytest.fail(f"liveness timeout: block indexes {indexes} < {target_block}")
+
+
+def _check_no_fork(nodes):
+    """Every block ALL nodes hold must be byte-identical (safety)."""
+    common = min(n.get_last_block_index() for n in nodes)
+    assert common >= 0
+    for bi in range(common + 1):
+        ref = nodes[0].get_block(bi).body.hash()
+        for n in nodes[1:]:
+            assert n.get_block(bi).body.hash() == ref, (
+                f"FORK: block {bi} differs on node {n.get_id()}"
+            )
+    return common
+
+
+def _shutdown_all(nodes):
+    for n in nodes:
+        n.shutdown()
+
+
+# -- the soak -------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_soak_partition_heal_converges():
+    """Acceptance (ISSUE-3): 5 nodes, 10% drop + duplication, 2
+    partition/heal rounds — all nodes converge to identical block hashes
+    and commit new blocks after heal; queues stay bounded."""
+    ctl = ChaosController(
+        seed=seed_from_env(),
+        default_faults=LinkFaults(drop=0.10, duplicate=0.05),
+        drop_hold_s=0.02,
+    )
+    nodes, proxies = make_chaos_cluster(5, ctl)
+    addrs = [f"inmem://node{i}" for i in range(5)]
+    try:
+        for n in nodes:
+            n.run_async()
+        # the cluster must commit under background drop+dup alone
+        _bombard_until(nodes, proxies, 1, timeout=90.0)
+
+        nem = Nemesis(
+            ctl,
+            partition_heal_cycle(
+                [addrs[:2], addrs[2:]],
+                first_at=0.0, partition_for=1.0, heal_for=1.0, rounds=2,
+            ),
+        ).start()
+        # keep traffic flowing THROUGH the partitions (it must not be lost)
+        t_end = time.monotonic() + 4.0
+        i = 0
+        while time.monotonic() < t_end:
+            proxies[i % 5].submit_tx(f"partition tx {i}".encode())
+            i += 1
+            time.sleep(0.05)
+        assert nem.wait(10.0)
+
+        # liveness after heal: NEW blocks commit
+        base = max(n.get_last_block_index() for n in nodes)
+        _bombard_until(nodes, proxies, base + 2, timeout=90.0)
+
+        # safety: no fork anywhere in the common prefix
+        common = _check_no_fork(nodes)
+        assert common >= base + 2
+
+        # bounded queue growth: the nemesis must not leave RPC backlogs
+        for n in nodes:
+            assert n.trans.consumer().qsize() < 64
+
+        assert not nem.errors, nem.errors
+
+        # the nemesis actually injected faults (not a quiet pass)
+        s = ctl.stats()
+        assert s["chaos_drops"] > 0
+        assert s["chaos_duplicates"] > 0
+        assert s["chaos_blocked_requests"] > 0
+    finally:
+        _shutdown_all(nodes)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_full_nemesis():
+    """Long soak: heavier loss, more partition rounds, a flapping peer and
+    a slow peer layered on top. Stays -m slow."""
+    ctl = ChaosController(
+        seed=seed_from_env(),
+        default_faults=LinkFaults(drop=0.15, duplicate=0.08,
+                                  delay_min_s=0.0, delay_max_s=0.01),
+        drop_hold_s=0.02,
+    )
+    nodes, proxies = make_chaos_cluster(5, ctl)
+    addrs = [f"inmem://node{i}" for i in range(5)]
+    try:
+        for n in nodes:
+            n.run_async()
+        _bombard_until(nodes, proxies, 1, timeout=120.0)
+
+        steps = (
+            partition_heal_cycle([addrs[:2], addrs[2:]], 0.0, 1.0, 1.0, 3)
+            + flapper(addrs[4], addrs[:4], first_at=6.5, down_for=0.5,
+                      up_for=0.5, rounds=3)
+            + slow_peer_window(addrs[1], at=10.0, duration=2.0,
+                               delay_min_s=0.005, delay_max_s=0.03)
+        )
+        nem = Nemesis(ctl, steps).start()
+        t_end = time.monotonic() + 12.5
+        i = 0
+        while time.monotonic() < t_end:
+            proxies[i % 5].submit_tx(f"storm tx {i}".encode())
+            i += 1
+            time.sleep(0.05)
+        assert nem.wait(20.0)
+        assert not nem.errors, nem.errors
+
+        base = max(n.get_last_block_index() for n in nodes)
+        _bombard_until(nodes, proxies, base + 2, timeout=120.0)
+        _check_no_fork(nodes)
+        for n in nodes:
+            assert n.trans.consumer().qsize() < 128
+    finally:
+        _shutdown_all(nodes)
+
+
+# -- handler-crash counters (ISSUE-3 satellite) ---------------------------
+
+
+def test_rpc_error_counters_distinguish_handler_crashes(monkeypatch):
+    """rpc_errors_* in get_stats move when a HANDLER crashes — so a chaos
+    run can tell 'dropped by nemesis' (counters still) from 'crashed in
+    handler' (counters move)."""
+    ctl = ChaosController(seed=seed_from_env())
+    nodes, _ = make_chaos_cluster(2, ctl)
+
+    def boom(*_a, **_k):
+        raise RuntimeError("injected handler crash")
+
+    try:
+        node = nodes[0]
+        assert node.get_stats()["rpc_errors_sync"] == "0"
+
+        monkeypatch.setattr(node.core, "event_diff", boom)
+        rpc = RPC(SyncRequest(from_id=nodes[1].get_id(), known={},
+                              sync_limit=10))
+        node._process_sync_request(rpc, rpc.command)
+        _, err = rpc.wait(timeout=1.0)
+        assert err and "injected" in err
+        assert node.get_stats()["rpc_errors_sync"] == "1"
+
+        monkeypatch.setattr(node.core, "prepare_sync", boom)
+        rpc2 = RPC(EagerSyncRequest(from_id=nodes[1].get_id(), events=[]))
+        node._process_eager_sync_request(rpc2, rpc2.command)
+        _, err2 = rpc2.wait(timeout=1.0)
+        assert err2
+        stats = node.get_stats()
+        assert stats["rpc_errors_eager_sync"] == "1"
+        # the other legs stayed clean
+        assert stats["rpc_errors_fast_forward"] == "0"
+        assert stats["rpc_errors_join"] == "0"
+    finally:
+        _shutdown_all(nodes)
+
+
+# -- shutdown / leave while partitioned (ISSUE-3 satellite) ---------------
+
+
+@pytest.mark.chaos
+def test_shutdown_bounded_during_partition():
+    """Node.shutdown() must return within its bounded wait_routines
+    budget with gossip threads parked on a partitioned peer — no
+    deadlock, and the routine pool drains (no orphan threads)."""
+    ctl = ChaosController(seed=seed_from_env(), drop_hold_s=1.0)
+    nodes, proxies = make_chaos_cluster(3, ctl)
+    addrs = [f"inmem://node{i}" for i in range(3)]
+    try:
+        for n in nodes:
+            n.run_async()
+        proxies[0].submit_tx(b"warmup")
+        time.sleep(0.3)  # let gossip threads get in flight
+        ctl.partition([[addrs[0]], addrs[1:]])
+        time.sleep(0.3)  # park node0's gossip rounds on the blocked links
+
+        t0 = time.monotonic()
+        nodes[0].shutdown()
+        elapsed = time.monotonic() - t0
+        # wait_routines timeout is 2.0 s; the hold is 1.0 s — anything
+        # near the transport's 5 s RPC deadline means we deadlocked
+        assert elapsed < 4.0, f"shutdown took {elapsed:.1f}s under partition"
+
+        # routine pool drains: parked rounds finish once their hold expires
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with nodes[0]._routines_lock:
+                live = nodes[0]._live
+            if live == 0:
+                break
+            time.sleep(0.05)
+        assert live == 0, f"{live} gossip routines orphaned after shutdown"
+    finally:
+        _shutdown_all(nodes)
+
+
+@pytest.mark.chaos
+def test_leave_bounded_during_partition():
+    """leave() on a partitioned node cannot reach consensus on its
+    PEER_REMOVE — it must time out within join_timeout + shutdown budget,
+    not hang."""
+    ctl = ChaosController(seed=seed_from_env(), drop_hold_s=0.2)
+    nodes, proxies = make_chaos_cluster(3, ctl, join_timeout=1.5)
+    addrs = [f"inmem://node{i}" for i in range(3)]
+    try:
+        for n in nodes:
+            n.run_async()
+        proxies[0].submit_tx(b"warmup")
+        time.sleep(0.3)
+        ctl.partition([[addrs[1]], [addrs[0], addrs[2]]])
+        time.sleep(0.2)
+
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            nodes[1].leave()  # consensus unreachable behind the partition
+        elapsed = time.monotonic() - t0
+        # leave waits ≤ join_timeout for the promise (+ up to 5 s replay
+        # guard) then shutdown's 2 s routine wait; 4x margin for CI
+        assert elapsed < 12.0, f"leave took {elapsed:.1f}s under partition"
+        assert nodes[1].get_state().name == "SHUTDOWN"
+    finally:
+        _shutdown_all(nodes)

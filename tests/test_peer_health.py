@@ -1,0 +1,222 @@
+"""Health-scored peer selection (node/peer_selector.py).
+
+Acceptance (ISSUE-3): a failing peer's selection share decays under
+repeated TransportErrors, the peer keeps getting probed once its backoff
+expires (never starved), and its share recovers after probes succeed.
+Clock and RNG are injected so the whole state machine runs without
+sleeping.
+"""
+
+from __future__ import annotations
+
+import random
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.node.peer_selector import RandomPeerSelector
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _peer_set(n: int) -> PeerSet:
+    return PeerSet(
+        [
+            Peer(f"inmem://p{i}", generate_key().public_key.hex(), f"p{i}")
+            for i in range(n)
+        ]
+    )
+
+
+def _selector(n=5, **kwargs):
+    ps = _peer_set(n)
+    self_id = ps.peers[0].id
+    clock = FakeClock()
+    sel = RandomPeerSelector(
+        ps, self_id, clock=clock, rng=random.Random(1234), **kwargs
+    )
+    return sel, ps, self_id, clock
+
+
+def _share(sel, clock, victim_id, rounds=400):
+    """Fraction of picks landing on victim when every pick succeeds for
+    everyone except the victim's health record is whatever it already is.
+    Advances the clock a little each pick so backoffs stay armed."""
+    hits = 0
+    for _ in range(rounds):
+        p = sel.next()
+        clock.advance(0.01)
+        if p.id == victim_id:
+            hits += 1
+        # report success for non-victims so 'last' moves on; the victim's
+        # record is left untouched by this sampler
+        if p.id != victim_id:
+            sel.update_last(p.id, True)
+        else:
+            sel.last = None
+    return hits / rounds
+
+
+def test_failing_peer_share_decays_then_recovers():
+    sel, ps, self_id, clock = _selector(5)
+    victim = next(p.id for p in ps.peers if p.id != self_id)
+
+    baseline = _share(sel, clock, victim)
+    assert 0.15 < baseline < 0.40  # ~1/4 among 4 candidates
+
+    # hammer the victim with failures: share must collapse
+    for _ in range(6):
+        sel.update_last(victim, False)
+        clock.advance(0.01)
+    clock.advance(sel.backoff_cap_s + 1.0)  # past the final backoff
+    # consume the due probe so the sampler measures the weighted share,
+    # not the deterministic probe pick
+    h = sel.health_of(victim)
+    h.next_probe = 0.0
+    degraded = _share(sel, clock, victim)
+    assert degraded < baseline / 3, (
+        f"share {degraded:.2%} did not decay from {baseline:.2%}"
+    )
+
+    # probes succeed: the peer heals and the share comes back
+    for _ in range(4):
+        sel.update_last(victim, True)
+    recovered = _share(sel, clock, victim)
+    assert recovered > baseline * 0.7
+
+
+def test_backed_off_peer_is_skipped_then_probed():
+    sel, ps, self_id, clock = _selector(5)
+    victim = next(p.id for p in ps.peers if p.id != self_id)
+
+    sel.update_last(victim, False)
+    h = sel.health_of(victim)
+    assert h.blocked_until > clock()  # backoff armed
+
+    # while backed off, the victim is never picked
+    for _ in range(100):
+        p = sel.next()
+        assert p.id != victim
+        if p.id != victim:
+            sel.update_last(p.id, True)
+    assert sel.backoff_skips > 0
+
+    # once the backoff expires, the next pick is a deterministic probe
+    clock.advance(sel.backoff_cap_s + 1.0)
+    sel.last = None
+    assert sel.next().id == victim
+    assert sel.probe_picks == 1
+    # and probes are rate-limited: the immediate next pick is not forced
+    probed_again = sel.next()
+    assert probed_again.id != victim or sel.probe_picks == 1
+
+
+def test_starvation_prefers_healthy_last_over_dead_peer():
+    """With every peer but the just-contacted one backed off, next() must
+    re-admit the healthy `last` peer instead of resurrecting a dead one."""
+    sel, ps, self_id, clock = _selector(4)
+    others = [p.id for p in ps.peers if p.id != self_id]
+    healthy, dead = others[0], others[1:]
+    for d in dead:
+        for _ in range(5):
+            sel.update_last(d, False)
+    sel.update_last(healthy, True)  # healthy is now `last`
+    # ensure no probe is due (backoffs still running)
+    assert all(sel.health_of(d).blocked_until > clock() for d in dead)
+    for _ in range(10):
+        assert sel.next().id == healthy
+    assert sel.starvation_overrides == 0
+
+
+def test_local_failure_with_penalize_false_keeps_health():
+    """connected=False with penalize=False (a LOCAL error, not the
+    network) records the flag but must not decay score or arm backoff."""
+    sel, ps, self_id, clock = _selector(3)
+    victim = next(p.id for p in ps.peers if p.id != self_id)
+    sel.update_last(victim, False, penalize=False)
+    h = sel.health_of(victim)
+    assert h.score == 1.0
+    assert h.failures == 0
+    assert h.blocked_until == 0.0
+
+
+def test_all_backed_off_still_returns_a_peer():
+    """Liveness beats politeness: under a full partition every peer fails,
+    but next() must still return someone."""
+    sel, ps, self_id, clock = _selector(4)
+    for p in ps.peers:
+        if p.id != self_id:
+            for _ in range(3):
+                sel.update_last(p.id, False)
+    picked = sel.next()
+    assert picked is not None
+    assert sel.starvation_overrides >= 1
+
+
+def test_backoff_grows_exponentially_and_resets():
+    sel, ps, self_id, clock = _selector(3)
+    victim = next(p.id for p in ps.peers if p.id != self_id)
+    widths = []
+    for _ in range(5):
+        sel.update_last(victim, False)
+        widths.append(sel.health_of(victim).blocked_until - clock())
+    # jitter is ±25%, doubling dominates it
+    assert widths[1] > widths[0]
+    assert widths[3] > widths[1]
+    assert max(widths) <= sel.backoff_cap_s * 1.25 + 1e-9
+    sel.update_last(victim, True)
+    h = sel.health_of(victim)
+    assert h.failures == 0 and h.blocked_until == 0.0
+
+
+def test_health_survives_peer_set_change():
+    """core.set_peers rebuilds the selector; surviving peers must keep
+    their scores and backoffs (no amnesty on membership change)."""
+    sel, ps, self_id, clock = _selector(5)
+    victim = next(p.id for p in ps.peers if p.id != self_id)
+    for _ in range(4):
+        sel.update_last(victim, False)
+    old_score = sel.health_of(victim).score
+
+    # drop one peer that is neither self nor the victim
+    dropped = next(
+        p.id for p in ps.peers if p.id not in (self_id, victim)
+    )
+    smaller = PeerSet([p for p in ps.peers if p.id != dropped])
+    rebuilt = RandomPeerSelector(smaller, self_id, prior=sel)
+    carried = rebuilt.health_of(victim)
+    assert carried is not None
+    assert carried.score == old_score
+    assert carried.failures == 4
+    assert rebuilt.health_of(dropped) is None
+    # tuning carried over too
+    assert rebuilt.backoff_cap_s == sel.backoff_cap_s
+    assert rebuilt._clock is clock
+
+
+def test_backoff_never_overflows_on_endless_failures():
+    """A permanently dead peer accrues failures forever; the clamped
+    exponent must keep returning the cap instead of raising
+    OverflowError (~attempt 1030 unclamped)."""
+    from babble_tpu.common.backoff import jittered_backoff
+
+    d = jittered_backoff(5000, 0.05, 2.0, jitter=0.25,
+                         rng=random.Random(1))
+    assert 0.0 < d <= 2.0
+
+
+def test_single_peer_always_returned():
+    sel, ps, self_id, clock = _selector(2)
+    only = next(p.id for p in ps.peers if p.id != self_id)
+    for _ in range(3):
+        sel.update_last(only, False)
+    assert sel.next().id == only  # nobody else to gossip with

@@ -169,6 +169,27 @@ def test_remote_error_propagates(pair):
         )
 
 
+def test_remote_errors_are_typed():
+    """Handler errors over inmem and TCP surface as RemoteError — the
+    network worked, so retry loops (fast-forward) treat them as
+    conclusive answers, not connectivity failures."""
+    from babble_tpu.net.transport import RemoteError
+
+    for kind in ("inmem", "tcp"):
+        cli, srv, cleanup = _make_pair(kind)
+        stop = threading.Event()
+        _responder(srv, {"SyncRequest": "handler exploded"}, stop)
+        try:
+            with pytest.raises(RemoteError):
+                cli.sync(
+                    srv.advertise_addr(),
+                    SyncRequest(from_id=1, known={}, sync_limit=10),
+                )
+        finally:
+            stop.set()
+            cleanup()
+
+
 def test_dial_failure():
     cli = TCPTransport("127.0.0.1:0")
     with pytest.raises(TransportError):
@@ -300,6 +321,186 @@ def test_tcp_pooled_connections():
         stop.set()
         cli.close()
         srv.close()
+
+
+def _one_shot_server(responses: dict):
+    """A raw framed-protocol server that serves exactly ONE RPC per
+    connection then closes it — manufacturing the stale-pooled-socket
+    condition (peer closed the connection between RPCs)."""
+    import socket
+    import struct
+
+    from babble_tpu.crypto.canonical import canonical_dumps
+    from babble_tpu.net.tcp import _recv_exact, _send_frame
+    from babble_tpu.net.rpc import REQUEST_TYPES
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    stop = threading.Event()
+    served = []
+
+    def run():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                type_byte = _recv_exact(conn, 1)[0]
+                (length,) = struct.unpack(">I", _recv_exact(conn, 4))
+                _recv_exact(conn, length)
+                resp = responses[REQUEST_TYPES[type_byte].__name__]
+                _send_frame(
+                    conn, None,
+                    canonical_dumps(
+                        {"error": None, "payload": resp.to_dict()}
+                    ),
+                )
+                served.append(1)
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()  # one RPC per connection, then hang up
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    addr = "127.0.0.1:%d" % srv.getsockname()[1]
+    return srv, addr, stop, served
+
+
+def test_tcp_stale_pooled_socket_retries_on_fresh_dial():
+    """A pooled socket the peer has since closed must not fail the RPC:
+    the pool is evicted and the RPC retried once on a fresh dial
+    (ISSUE-3 satellite: TCP pool hardening)."""
+    srv, addr, stop, served = _one_shot_server(
+        {"SyncRequest": SyncResponse(from_id=5, events=[], known={})}
+    )
+    cli = TCPTransport("127.0.0.1:0")
+    try:
+        req = SyncRequest(from_id=1, known={}, sync_limit=10)
+        assert cli.sync(addr, req).from_id == 5
+        # the socket went back to the pool, but the server closed its end
+        with cli._pool_lock:
+            assert sum(len(v) for v in cli._pool.values()) == 1
+        time.sleep(0.1)  # let the server-side FIN land
+        assert cli.sync(addr, req).from_id == 5  # salvaged by the retry
+        assert cli.retries == 1
+        assert cli.pool_evictions >= 1
+        assert len(served) == 2
+    finally:
+        stop.set()
+        srv.close()
+        cli.close()
+
+
+def test_tcp_timeout_on_pooled_socket_is_not_retried():
+    """An RPC timeout means the peer is slow/gone, not that the pooled
+    socket was stale — it must surface after ONE timeout period, never
+    trigger the fresh-dial retry (which would double latency and deliver
+    the request twice to a slow-but-alive peer)."""
+    import socket as _socket
+    import struct as _struct
+
+    from babble_tpu.crypto.canonical import canonical_dumps
+    from babble_tpu.net.rpc import REQUEST_TYPES
+    from babble_tpu.net.tcp import _recv_exact, _send_frame
+
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+    served = []
+
+    def run():
+        # per connection: answer the FIRST RPC, then go silent (slow peer)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    type_byte = _recv_exact(conn, 1)[0]
+                    (ln,) = _struct.unpack(">I", _recv_exact(conn, 4))
+                    _recv_exact(conn, ln)
+                    if served:
+                        stop.wait(5.0)  # stall well past the RPC timeout
+                        break
+                    resp = SyncResponse(from_id=3, events=[], known={})
+                    _send_frame(
+                        conn, None,
+                        canonical_dumps(
+                            {"error": None, "payload": resp.to_dict()}
+                        ),
+                    )
+                    served.append(REQUEST_TYPES[type_byte].__name__)
+            except Exception:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=run, daemon=True).start()
+    addr = "127.0.0.1:%d" % srv.getsockname()[1]
+    cli = TCPTransport("127.0.0.1:0", timeout=0.5)
+    try:
+        req = SyncRequest(from_id=1, known={}, sync_limit=10)
+        assert cli.sync(addr, req).from_id == 3  # pooled afterwards
+        t0 = time.monotonic()
+        with pytest.raises(TransportError):
+            cli.sync(addr, req)  # pooled socket, server stalls
+        elapsed = time.monotonic() - t0
+        assert cli.retries == 0, "timeout must not trigger a retry"
+        assert elapsed < 1.5, f"timeout surfaced after {elapsed:.1f}s (retried?)"
+    finally:
+        stop.set()
+        srv.close()
+        cli.close()
+
+
+def test_tcp_remote_error_is_not_retried():
+    """A remote handler error means the peer processed the request — it
+    must surface immediately, never trigger the fresh-dial retry."""
+    srv = TCPTransport("127.0.0.1:0")
+    srv.listen()
+    cli = TCPTransport("127.0.0.1:0")
+    stop = threading.Event()
+    _responder(srv, {"SyncRequest": "handler exploded"}, stop)
+    try:
+        req = SyncRequest(from_id=1, known={}, sync_limit=10)
+        for _ in range(2):  # second call uses the pooled socket
+            with pytest.raises(TransportError, match="remote error"):
+                cli.sync(srv.advertise_addr(), req)
+        assert cli.retries == 0
+    finally:
+        stop.set()
+        cli.close()
+        srv.close()
+
+
+def test_tcp_dial_timeout_is_explicit():
+    """The connect deadline is the dial timeout, not the (much longer)
+    RPC timeout."""
+    cli = TCPTransport("127.0.0.1:0", timeout=30.0, dial_timeout=0.5)
+    try:
+        assert cli._dial_timeout == 0.5
+        t0 = time.monotonic()
+        with pytest.raises(TransportError):
+            cli.sync(
+                "127.0.0.1:1", SyncRequest(from_id=1, known={}, sync_limit=1)
+            )
+        # refused or timed out — either way far below the RPC timeout
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        cli.close()
 
 
 def test_tcp_bad_addr():
