@@ -48,6 +48,13 @@ _RUN_FLAGS = {
     "moniker": ("moniker", str),
     "accelerator": ("accelerator", bool),
     "accelerator_mesh": ("accelerator_mesh", int),
+    "mempool_max_txs": ("mempool_max_txs", int),
+    "mempool_max_bytes": ("mempool_max_bytes", int),
+    "mempool_overflow": ("mempool_overflow", str),
+    "mempool_event_max_txs": ("mempool_event_max_txs", int),
+    "mempool_event_max_bytes": ("mempool_event_max_bytes", int),
+    "mempool_rate": ("mempool_rate", float),
+    "submit_batch": ("submit_batch", int),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
@@ -201,7 +208,11 @@ def cmd_dummy(args: argparse.Namespace) -> int:
                 if not line:
                     continue
                 try:
-                    client.submit_tx(line.encode())
+                    verdict = client.submit_tx(line.encode())
+                    if verdict != "accepted":
+                        # shed/duplicate verdicts (docs/mempool.md) must
+                        # reach the user — the message will NOT commit
+                        print(f"submit verdict: {verdict}", file=sys.stderr)
                 except Exception as err:
                     # a dropped tx is recoverable; keep the chat alive
                     print(f"submit failed ({err}); is the node up?",
@@ -252,6 +263,35 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--accelerator-mesh", dest="accelerator_mesh", type=int, default=None,
         help="shard voting sweeps over this many devices (multi-chip)",
+    )
+    run.add_argument(
+        "--mempool-max-txs", dest="mempool_max_txs", type=int, default=None,
+        help="mempool capacity in transactions (admission cap)",
+    )
+    run.add_argument(
+        "--mempool-max-bytes", dest="mempool_max_bytes", type=int,
+        default=None, help="mempool capacity in bytes",
+    )
+    run.add_argument(
+        "--mempool-overflow", dest="mempool_overflow", default=None,
+        choices=("reject", "evict-oldest"),
+        help="behavior at capacity: reject new txs (default) or evict oldest",
+    )
+    run.add_argument(
+        "--mempool-event-max-txs", dest="mempool_event_max_txs", type=int,
+        default=None, help="max client txs packaged per self-event",
+    )
+    run.add_argument(
+        "--mempool-event-max-bytes", dest="mempool_event_max_bytes",
+        type=int, default=None, help="max client tx bytes per self-event",
+    )
+    run.add_argument(
+        "--mempool-rate", dest="mempool_rate", type=float, default=None,
+        help="token-bucket admission rate in tx/s (0 = unlimited)",
+    )
+    run.add_argument(
+        "--submit-batch", dest="submit_batch", type=int, default=None,
+        help="submit-queue transactions drained per background pass",
     )
     run.add_argument(
         "--signal", action="store_true",

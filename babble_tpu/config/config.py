@@ -66,6 +66,26 @@ class Config:
     # empty = plaintext relay link.
     signal_ca: str = ""
 
+    # Mempool (docs/mempool.md): the bounded dedup transaction pool
+    # between app submission and self-event creation. Caps are admission
+    # bounds in count and bytes; the overflow policy is "reject" (client
+    # sees `full`) or "evict-oldest" (oldest pending tx shed, client
+    # accepted); event caps bound each self-event so gossip payloads stay
+    # small under load; the committed LRU turns retries of committed
+    # transactions into `already_committed`; rate>0 arms a token-bucket
+    # limiter (`throttled` under sustained overload; burst 0 = 1 s worth).
+    mempool_max_txs: int = 20000
+    mempool_max_bytes: int = 33554432  # 32 MiB
+    mempool_overflow: str = "reject"  # or "evict-oldest"
+    mempool_event_max_txs: int = 1024
+    mempool_event_max_bytes: int = 1048576  # 1 MiB per self-event
+    mempool_committed_lru: int = 65536
+    mempool_rate: float = 0.0  # tx/s; 0 disables the limiter
+    mempool_burst: float = 0.0  # 0 = one second's worth of tokens
+    # Submit-queue drain batch per background pass: bounded so a flood of
+    # submissions can't starve transport RPC handling in the same loop.
+    submit_batch: int = 256
+
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory
     database_dir: str = ""
@@ -90,6 +110,11 @@ class Config:
             self.bootstrap = True
         if self.bootstrap:
             self.store = True
+        if self.mempool_overflow not in ("reject", "evict-oldest"):
+            raise ValueError(
+                f"mempool_overflow must be 'reject' or 'evict-oldest', "
+                f"got {self.mempool_overflow!r}"
+            )
 
     def keyfile_path(self) -> str:
         return os.path.join(self.data_dir, DEFAULT_KEYFILE)

@@ -16,8 +16,12 @@ class DummySocketClient:
         self.proxy = SocketBabbleProxy(bind_addr, babble_addr, self.state)
         self.addr = self.proxy.addr
 
-    def submit_tx(self, tx: bytes) -> None:
-        self.proxy.submit_tx(tx)
+    def submit_tx(self, tx: bytes) -> str:
+        """Submit a transaction; returns the node's admission verdict
+        ("accepted" | "duplicate" | "already_committed" | "full" |
+        "throttled" | "oversized" — docs/mempool.md) so clients like
+        demo/bombard.py can back off and report shed rates."""
+        return self.proxy.submit_tx(tx)
 
     def close(self) -> None:
         self.proxy.close()

@@ -24,6 +24,7 @@ from ..hashgraph.internal_transaction import (
     TransactionType,
 )
 from ..hashgraph.store import Store
+from ..mempool import Mempool
 from ..peers.peer_set import PeerSet
 from .peer_selector import RandomPeerSelector
 from .promise import JoinPromise
@@ -67,6 +68,7 @@ class Core:
         maintenance_mode: bool = False,
         accelerated_verify: bool = False,
         accelerator_mesh: int = 0,
+        mempool: Optional[Mempool] = None,
     ):
         # Gate the TPU batch-verify path behind a flag (the reference's
         # north-star `--accelerator` switch); jax is only imported when on.
@@ -96,7 +98,10 @@ class Core:
         # (reference: core.go:66-73).
         self.heads: Dict[int, Optional[Event]] = {}
 
-        self.transaction_pool: List[bytes] = []
+        # Client transactions live in the mempool (bounded, deduplicating,
+        # own lock — docs/mempool.md); the internal-transaction pool keeps
+        # its own small list path (membership itxs are rare and trusted).
+        self.mempool = mempool if mempool is not None else Mempool()
         self.internal_transaction_pool: List[InternalTransaction] = []
         self.self_block_signatures = {}  # key -> BlockSignature
         self.promises: Dict[str, JoinPromise] = {}
@@ -163,7 +168,7 @@ class Core:
         (reference: core.go:196-202)."""
         return (
             self.hg.pending_loaded_events > 0
-            or len(self.transaction_pool) > 0
+            or self.mempool.pending_count > 0
             or len(self.internal_transaction_pool) > 0
             or len(self.self_block_signatures) > 0
             or (self.hg.accel is not None and self.hg.accel.busy())
@@ -383,11 +388,16 @@ class Core:
             return
 
         sigs = list(self.self_block_signatures.values())
-        n_txs = len(self.transaction_pool)
         n_itxs = len(self.internal_transaction_pool)
 
+        # Batch drain under the mempool's caps: each self-event carries at
+        # most event_max_txs / event_max_bytes of client transactions, so
+        # gossip payloads stay bounded under sustained overload; leftovers
+        # keep busy() true and ride the next event (FIFO fairness).
+        txs = self.mempool.drain()
+
         new_head = Event.new(
-            self.transaction_pool[:n_txs],
+            txs,
             self.internal_transaction_pool[:n_itxs],
             sigs,
             [self.head, other_head],
@@ -398,8 +408,14 @@ class Core:
 
         # Inserting can add items to the pools via the commit callback, so
         # only the packaged prefix is dropped (reference: core.go:325-330).
-        self.sign_and_insert_self_event(new_head)
-        self.transaction_pool = self.transaction_pool[n_txs:]
+        # A failed insert puts the drained batch back at the FRONT of the
+        # mempool — accepted transactions are never lost to a transient
+        # event-creation failure.
+        try:
+            self.sign_and_insert_self_event(new_head)
+        except Exception:
+            self.mempool.requeue(txs)
+            raise
         self.internal_transaction_pool = self.internal_transaction_pool[n_itxs:]
         for s in sigs:
             self.self_block_signatures.pop(s.key(), None)
@@ -504,6 +520,13 @@ class Core:
         it, and process membership receipts (reference: core.go:485-536)."""
         commit_response = self.proxy_commit_callback(block)
 
+        # Feed the committed-hash LRU atomically with the commit (under
+        # the mempool's own lock): from here on a client retry of any of
+        # these transactions gets `already_committed`, and pending copies
+        # (same tx submitted to several nodes, committed via another's
+        # event) are dropped before they can double-commit.
+        self.mempool.mark_committed(block.transactions())
+
         block.body.state_hash = commit_response.state_hash
         block.body.internal_transaction_receipts = commit_response.receipts
 
@@ -596,8 +619,16 @@ class Core:
     def process_sig_pool(self) -> None:
         self.hg.process_sig_pool()
 
-    def add_transactions(self, txs: List[bytes]) -> None:
-        self.transaction_pool.extend(txs)
+    @property
+    def transaction_pool(self) -> List[bytes]:
+        """FIFO snapshot of the mempool's pending transactions (read-only
+        compatibility view of the reference's transactionPool slice)."""
+        return self.mempool.pending_txs()
+
+    def add_transactions(self, txs: List[bytes]) -> List[str]:
+        """Admit transactions through the mempool; returns the verdicts
+        (reference: core.go:740-745 appended unconditionally)."""
+        return self.mempool.submit_many(txs)
 
     def add_internal_transaction(self, tx: InternalTransaction) -> JoinPromise:
         """reference: core.go:747-758."""

@@ -66,6 +66,8 @@ class Node(StateManager):
         super().__init__()
         self.conf = conf
         self.logger = conf.logger("node")
+        from ..mempool import Mempool
+
         self.core = Core(
             validator,
             peers,
@@ -75,6 +77,7 @@ class Node(StateManager):
             conf.maintenance_mode,
             accelerated_verify=conf.accelerator,
             accelerator_mesh=conf.accelerator_mesh,
+            mempool=Mempool.from_config(conf),
         )
         # Instrumented core lock: get_stats surfaces total acquisition
         # wait (lock_wait_ms_total) so lock-shrinking work stays measured.
@@ -82,6 +85,12 @@ class Node(StateManager):
         self.trans = trans
         self.proxy = proxy
         self.submit_q = proxy.submit_queue()
+        # Synchronous admission: a proxy that supports it hands SubmitTx
+        # straight to the mempool (its own lock, never the core lock) and
+        # returns the verdict to the client; the queue below stays as the
+        # fallback for proxies predating verdicts.
+        if hasattr(proxy, "set_submit_handler"):
+            proxy.set_submit_handler(self._admit_transaction)
         self.control_timer = ControlTimer()
         self.shutdown_event = threading.Event()
         self.suspend_event = threading.Event()
@@ -301,7 +310,7 @@ class Node(StateManager):
             "consensus_events": str(self.core.get_consensus_events_count()),
             "undetermined_events": str(len(self.core.get_undetermined_events())),
             "transactions": str(self.core.get_consensus_transactions_count()),
-            "transaction_pool": str(len(self.core.transaction_pool)),
+            "transaction_pool": str(self.core.mempool.pending_count),
             "num_peers": str(len(self.core.peer_selector.get_peers())),
             "last_peer_change": str(self.core.last_peer_change_round),
             "id": str(self.get_id()),
@@ -334,6 +343,14 @@ class Node(StateManager):
                 "norm_cache_misses": str(NORM_CACHE.misses),
             }
         )
+        # Mempool surface (docs/mempool.md): admission verdict counters,
+        # pending gauges, eviction/requeue totals.
+        stats.update(
+            {
+                f"mempool_{k}": str(v)
+                for k, v in self.core.mempool.stats().items()
+            }
+        )
         # Robustness surface: handler crash counters per RPC type, and the
         # peer selector's health/backoff view of the network.
         stats.update(
@@ -363,8 +380,13 @@ class Node(StateManager):
                 self.go_func(lambda r=rpc: (self._process_rpc(r), self._reset_timer()))
             except queue.Empty:
                 pass
+            # Batch-drain the submit queue, BOUNDED per pass: the old
+            # one-get_nowait-per-transaction shape admitted one tx per
+            # loop iteration under load, while an unbounded drain would
+            # starve the transport consumer above. Up to conf.submit_batch
+            # transactions go through mempool admission per pass.
             try:
-                while True:
+                for _ in range(max(1, self.conf.submit_batch)):
                     tx = self.submit_q.get_nowait()
                     handled = True
                     self._add_transaction(tx)
@@ -793,10 +815,22 @@ class Node(StateManager):
             self.core.set_head_and_seq()
             self._transition(State.BABBLING)
 
-    def _add_transaction(self, tx: bytes) -> None:
-        """reference: node.go:784-789."""
-        with self.core_lock:
-            self.core.add_transactions([tx])
+    def _add_transaction(self, tx: bytes) -> str:
+        """reference: node.go:784-789 — but admission happens under the
+        mempool's OWN lock, not the core lock: a submit storm contends
+        with other submits, never with the insert/consensus pipeline."""
+        return self._admit_transaction(tx)
+
+    def _admit_transaction(self, tx: bytes) -> str:
+        """Mempool admission; returns the verdict (proxy submit handler)."""
+        return self.core.mempool.submit(tx)
+
+    def get_mempool(self) -> Dict[str, object]:
+        """/mempool service payload: knobs + live counters."""
+        return {
+            "config": self.core.mempool.config(),
+            "stats": self.core.mempool.stats(),
+        }
 
     def _log_stats(self) -> None:
         self.logger.debug("stats: %s", self.get_stats())

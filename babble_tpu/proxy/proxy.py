@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import queue
 from dataclasses import dataclass, field
-from typing import List, Protocol
+from typing import Callable, List, Optional, Protocol
 
 from ..hashgraph.block import Block
 from ..hashgraph.internal_transaction import InternalTransactionReceipt
@@ -51,7 +51,13 @@ class ProxyHandler(Protocol):
 
 class AppProxy(Protocol):
     """What the node needs from the application side
-    (reference: proxy/proxy.go:10-16)."""
+    (reference: proxy/proxy.go:10-16).
+
+    Proxies MAY additionally expose ``set_submit_handler(fn)``: the node
+    registers a synchronous admission callback ``fn(tx) -> verdict`` (the
+    mempool's, docs/mempool.md) so SubmitTx returns an explicit verdict
+    instead of queueing blindly. The node probes for it with hasattr —
+    proxies without it keep the queue-only shape."""
 
     def submit_queue(self) -> "queue.Queue[bytes]": ...
 
@@ -71,13 +77,24 @@ class InmemProxy:
     def __init__(self, handler: ProxyHandler):
         self.handler = handler
         self._submit: "queue.Queue[bytes]" = queue.Queue()
+        self._submit_handler: Optional[Callable[[bytes], str]] = None
+
+    def set_submit_handler(self, fn: Callable[[bytes], str]) -> None:
+        """Node-side admission callback; makes submit_tx return verdicts."""
+        self._submit_handler = fn
 
     # -- app-facing ---------------------------------------------------------
 
-    def submit_tx(self, tx: bytes) -> None:
+    def submit_tx(self, tx: bytes) -> str:
         """Called by the application to submit a transaction
-        (reference: inmem_proxy.go:44-52)."""
+        (reference: inmem_proxy.go:44-52). Returns the mempool admission
+        verdict when a node is attached; queues (and reports "accepted")
+        before one is."""
+        fn = self._submit_handler
+        if fn is not None:
+            return fn(bytes(tx))
         self._submit.put(bytes(tx))
+        return "accepted"
 
     # -- AppProxy interface -------------------------------------------------
 

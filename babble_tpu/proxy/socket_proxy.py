@@ -161,13 +161,25 @@ class SocketAppProxy:
 
     def __init__(self, bind_addr: str, client_addr: str, timeout: float = 10.0):
         self._submit: "queue.Queue[bytes]" = queue.Queue()
+        self._submit_handler: Optional[Callable[[bytes], str]] = None
         self._client = JsonRpcClient(client_addr, timeout)
         self._server = JsonRpcServer(
             bind_addr, {"Babble.SubmitTx": self._submit_tx}
         )
         self.addr = self._server.addr
 
-    def _submit_tx(self, tx_b64: str) -> bool:
+    def set_submit_handler(self, fn: Callable[[bytes], str]) -> None:
+        """Node-side admission callback; SubmitTx then answers with the
+        mempool verdict string instead of the reference's bare ``true``
+        (wire divergence recorded in docs/parity.md)."""
+        self._submit_handler = fn
+
+    def _submit_tx(self, tx_b64: str):
+        fn = self._submit_handler
+        if fn is not None:
+            return fn(unb64(tx_b64))
+        # No node attached yet: queue, and keep the reference's bool reply
+        # so bare proxies stay wire-compatible.
         self._submit.put(unb64(tx_b64))
         return True
 
@@ -266,8 +278,14 @@ class SocketBabbleProxy:
 
     # -- app-facing ---------------------------------------------------------
 
-    def submit_tx(self, tx: bytes) -> None:
-        self._client.call("Babble.SubmitTx", json.loads(canonical_dumps(tx)))
+    def submit_tx(self, tx: bytes) -> str:
+        """Submit to Babble; returns the admission verdict. A reference-
+        shaped peer (or a proxy with no node attached) answers ``true`` —
+        mapped to "accepted" so callers see one vocabulary."""
+        result = self._client.call(
+            "Babble.SubmitTx", json.loads(canonical_dumps(tx))
+        )
+        return "accepted" if result is True else str(result)
 
     def close(self) -> None:
         self._server.close()

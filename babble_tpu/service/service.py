@@ -74,6 +74,9 @@ class Service:
                 return
             if path == "/stats":
                 body = self.node.get_stats()
+            elif path == "/mempool":
+                # admission knobs + live counters (docs/mempool.md)
+                body = self.node.get_mempool()
             elif path.startswith("/block/"):
                 body = _jsonable(
                     self.node.get_block(int(path[len("/block/"):])).to_dict()

@@ -907,9 +907,212 @@ def bench_ingest(n_peers: int = 8, n_events: int = 1024,
     }
 
 
+def bench_mempool(n_nodes: int = 4, window_s: float = 8.0,
+                  cap: int = 2000, smoke: bool = False):
+    """Sustained-overload mempool bench (ISSUE 4): one 4-node in-process
+    cluster, two phases on the SAME nodes.
+
+    Phase A (baseline): closed-loop load with a small backlog cap —
+    committed tx/s with the mempool far from its limits.
+
+    Phase B (overload): open-loop flood at ≥10x the measured baseline
+    rate against a small admission cap (``Config.mempool_max_txs``).
+    Reports committed tx/s under overload, the shed rate (full+throttled
+    / submitted), the max pending observed (must stay ≤ cap), and — after
+    a drain phase — whether every ACCEPTED transaction committed exactly
+    once (``accepted_lost`` / ``accepted_dup_commits`` must be 0).
+
+    The acceptance shape: admission control sheds load at the door, so
+    committed throughput under a 10x flood stays near the baseline
+    (``overload_ratio``) instead of collapsing under unbounded queues."""
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.inmem import InmemNetwork
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+    from babble_tpu.proxy.proxy import InmemProxy
+
+    if smoke:
+        window_s = 3.0
+        cap = 600
+
+    net = InmemNetwork()
+    keys = [generate_key() for _ in range(n_nodes)]
+    peers = PeerSet(
+        [Peer(f"inmem://mp{i}", k.public_key.hex(), f"mp{i}")
+         for i, k in enumerate(keys)]
+    )
+    addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.01,
+            slow_heartbeat_timeout=0.2,
+            log_level="error",
+            moniker=f"mp{i}",
+            mempool_max_txs=cap,
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        node = Node(conf, Validator(k, f"mp{i}"), peers, peers,
+                    InmemStore(conf.cache_size),
+                    net.new_transport(addr[k.public_key.hex()]), pr)
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    for n in nodes:
+        n.run_async()
+
+    def committed() -> int:
+        return min(len(s.committed_txs) for s in states)
+
+    seq = {"i": 0}
+
+    def submit_one(_=None) -> str:
+        i = seq["i"]
+        seq["i"] += 1
+        tx = f"mpool tx {i} ".encode().ljust(100, b"x")
+        return proxies[i % n_nodes].submit_tx(tx), tx
+
+    try:
+        # Phase A: baseline (closed loop, backlog well under the cap).
+        baseline = _measure_rate(
+            lambda i: submit_one(),
+            committed,
+            window_s,
+            warmup_s=2.0 if smoke else 3.0,
+            max_backlog=cap // 2,
+        )
+
+        # Phase B: open-loop flood starting at >= 10x the baseline. The
+        # baseline (closed-loop, backlog-capped) understates capacity when
+        # overload packs events full, so the rate ESCALATES every 0.25 s
+        # until admission actually sheds (`full` verdicts) — the bench
+        # must measure committed throughput while the pool is genuinely
+        # overrun, not a flood the cluster quietly absorbs.
+        offered = max(10.0 * baseline, 500.0)
+        offered_max = offered
+        verdicts: dict = {}
+        accepted: list = []
+        pending_max = 0
+        t0 = time.monotonic()
+        last = t0
+        last_escalate = t0
+        carry = 0.0
+        base_committed = committed()
+        sent0 = seq["i"]
+        while True:
+            now = time.monotonic()
+            if now - t0 >= window_s:
+                break
+            carry += (now - last) * offered
+            last = now
+            n_due = int(carry)
+            carry -= n_due
+            for _ in range(n_due):
+                v, tx = submit_one()
+                verdicts[v] = verdicts.get(v, 0) + 1
+                if v == "accepted":
+                    accepted.append(tx)
+            pending_now = max(n.core.mempool.pending_count for n in nodes)
+            pending_max = max(pending_max, pending_now)
+            if (
+                now - last_escalate > 0.25
+                and verdicts.get("full", 0) == 0
+                and verdicts.get("throttled", 0) == 0
+            ):
+                offered *= 2.0
+                offered_max = offered
+                last_escalate = now
+            time.sleep(0.002)
+        elapsed = time.monotonic() - t0
+        overload_rate = (committed() - base_committed) / elapsed
+        submitted = seq["i"] - sent0
+        shed = verdicts.get("full", 0) + verdicts.get("throttled", 0)
+
+        # Drain: every accepted tx must commit exactly once, on all nodes.
+        # Incremental scan — rebuilding a set of (and counting over) tens
+        # of thousands of committed txs every poll is quadratic and can
+        # stall the full bench for minutes.
+        deadline = time.monotonic() + (60.0 if smoke else 120.0)
+        want = set(accepted)
+        scanned = 0
+        seen: set = set()
+        while time.monotonic() < deadline:
+            committed_list = states[0].committed_txs
+            n_now = len(committed_list)
+            seen.update(committed_list[scanned:n_now])
+            scanned = n_now
+            if want <= seen:
+                break
+            time.sleep(0.05)
+        from collections import Counter
+
+        counts = Counter(states[0].committed_txs)
+        lost = sum(1 for tx in want if counts[tx] == 0)
+        dups = sum(1 for tx in want if counts[tx] > 1)
+
+        mem_stats = nodes[0].core.mempool.stats()
+        return {
+            "n_nodes": n_nodes,
+            "pending_cap": cap,
+            "baseline_txs_per_s": round(baseline, 1),
+            "offered_tx_s": round(offered_max, 1),
+            "overload_txs_per_s": round(overload_rate, 1),
+            "overload_ratio": (
+                round(overload_rate / baseline, 3) if baseline > 0 else None
+            ),
+            "submitted": submitted,
+            "accepted": verdicts.get("accepted", 0),
+            "shed": shed,
+            "shed_rate": round(shed / submitted, 4) if submitted else None,
+            "verdicts": verdicts,
+            "pending_max": pending_max,
+            "cap_exceeded": pending_max > cap,
+            "accepted_lost": lost,
+            "accepted_dup_commits": dups,
+            "node0_mempool": {
+                k: mem_stats[k]
+                for k in ("accepted", "rejected_full", "rejected_dup",
+                          "committed_dedup_hits", "evictions", "requeued")
+            },
+        }
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+def main_mempool(smoke: bool = False) -> None:
+    """`make mempoolsmoke` / `bench.py --mempool`: the sustained-overload
+    mempool bench, detail on stderr and ONE parseable JSON line on
+    stdout (the tail-capture contract)."""
+    res = bench_mempool(smoke=smoke)
+    print(
+        f"mempool: baseline={res['baseline_txs_per_s']} tx/s, "
+        f"overload committed={res['overload_txs_per_s']} tx/s "
+        f"(ratio {res['overload_ratio']}) at offered="
+        f"{res['offered_tx_s']} tx/s; shed_rate={res['shed_rate']} "
+        f"pending_max={res['pending_max']}/{res['pending_cap']} "
+        f"lost={res['accepted_lost']} dups={res['accepted_dup_commits']}",
+        file=sys.stderr,
+    )
+    line = json.dumps(
+        {"bench_summary": "mempool_smoke" if smoke else "mempool", **res},
+        separators=(",", ":"),
+    )
+    assert len(line) < 2000, "mempool summary exceeded tail-capture budget"
+    print(line)
+
+
 # Keys dropped FIRST (in order) when the compact summary line would
 # exceed the driver's tail-capture budget.
 _SUMMARY_OPTIONAL_KEYS = (
+    "mempool",
     "dagw",
     "ingest",
     "cfg3_threads_accel_txs_per_s",
@@ -1487,6 +1690,8 @@ def main_dag(smoke: bool = False) -> None:
 def main() -> None:
     if "--dag" in sys.argv:
         return main_dag("--smoke" in sys.argv)
+    if "--mempool" in sys.argv:
+        return main_mempool("--smoke" in sys.argv)
     if "--all" in sys.argv:
         return main_all()
     if "--smoke" in sys.argv:
@@ -1675,6 +1880,23 @@ def main() -> None:
         ingest = {"error": f"{type(err).__name__}: {err}"}
         print(f"ingest microbench failed: {err}", file=sys.stderr)
 
+    # Mempool under sustained overload (ISSUE 4): committed throughput
+    # held near baseline by admission-control shedding, no accepted loss.
+    try:
+        mempool_res = bench_mempool()
+        print(
+            f"mempool overload: baseline={mempool_res['baseline_txs_per_s']} "
+            f"tx/s, overload committed={mempool_res['overload_txs_per_s']} "
+            f"tx/s (ratio {mempool_res['overload_ratio']}), "
+            f"shed_rate={mempool_res['shed_rate']}, "
+            f"pending_max={mempool_res['pending_max']}"
+            f"/{mempool_res['pending_cap']}",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        mempool_res = {"error": f"{type(err).__name__}: {err}"}
+        print(f"mempool bench failed: {err}", file=sys.stderr)
+
     eps, dag_dt, device, dag_E, mfu, dag_err = bench_dag_pipeline_guarded()
 
     # Incremental vs full-rebuild live sweeps (ISSUE 2): per-stage
@@ -1732,6 +1954,7 @@ def main() -> None:
         "config4_churn": config4,
         "config5_adversarial": config5,
         "subprocess_4node": procs,
+        "mempool_overload": mempool_res,
         "device_verify": device_verify,
         "ingest_fastpath": ingest,
         "dag_incremental": dag_incr,
@@ -1786,6 +2009,23 @@ def main() -> None:
                 "cfg4_churn_txs_per_s": config4.get("txs_per_s"),
                 "cfg5_adversarial_txs_per_s": config5.get("txs_per_s"),
                 "ingest": ingest,
+                # Mempool overload digest (ISSUE 4): committed throughput
+                # ratio under a 10x flood, shed rate, bounded pending,
+                # and the exactly-once check.
+                "mempool": (
+                    {
+                        "base": mempool_res["baseline_txs_per_s"],
+                        "over": mempool_res["overload_txs_per_s"],
+                        "ratio": mempool_res["overload_ratio"],
+                        "shed_rate": mempool_res["shed_rate"],
+                        "pend_max": mempool_res["pending_max"],
+                        "cap": mempool_res["pending_cap"],
+                        "lost": mempool_res["accepted_lost"],
+                        "dup": mempool_res["accepted_dup_commits"],
+                    }
+                    if "error" not in mempool_res
+                    else mempool_res
+                ),
                 # Incremental-window digest (ISSUE 2): per-sweep cost in
                 # both modes, the incremental arm's stage breakdown, and
                 # the rows_delta/rows_reused/rebuilds counters.

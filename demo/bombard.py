@@ -3,6 +3,11 @@
 proxies (reference: /root/reference/demo/scripts/bombard.sh, which pushes
 JSON-RPC via netcat; here we speak the framed JSON-RPC directly).
 
+SubmitTx answers with an admission verdict (docs/mempool.md). This
+client honors it: `throttled`/`full` back off (jittered, capped) and
+retry instead of hammering a shedding node; retries exhausted count as
+shed. Totals (accepted / shed / duplicate / ...) print at exit.
+
 Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
 """
 
@@ -11,10 +16,33 @@ from __future__ import annotations
 import base64
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from babble_tpu.common.backoff import jittered_backoff  # noqa: E402
 from babble_tpu.proxy.socket_proxy import JsonRpcClient  # noqa: E402
+
+MAX_RETRIES = 8  # per transaction, on throttled/full
+
+
+def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> None:
+    """Submit one tx, backing off and retrying on overload verdicts."""
+    attempt = 0
+    while True:
+        result = client.call(
+            "Babble.SubmitTx", base64.b64encode(tx).decode("ascii")
+        )
+        verdict = "accepted" if result is True else str(result)
+        if verdict in ("throttled", "full") and attempt < MAX_RETRIES:
+            attempt += 1
+            counts["backoffs"] += 1
+            time.sleep(jittered_backoff(attempt, 0.005, 0.5))
+            continue
+        if verdict in ("throttled", "full"):
+            counts["shed"] += 1
+        counts[verdict] = counts.get(verdict, 0) + 1
+        return
 
 
 def main() -> int:
@@ -26,18 +54,28 @@ def main() -> int:
         if a.startswith("--base-port"):
             base_port = int(a.split("=", 1)[1])
 
+    counts: dict = {"shed": 0, "backoffs": 0}
     sent = 0
     for i in range(n):
         client = JsonRpcClient(f"127.0.0.1:{base_port + i}")
         for j in range(m):
             tx = f"node{i} tx {j}".encode()
-            client.call(
-                "Babble.SubmitTx", base64.b64encode(tx).decode("ascii")
-            )
+            submit_with_backoff(client, tx, counts)
             sent += 1
         client.close()
         print(f"node{i}: {m} txs submitted")
+    accepted = counts.get("accepted", 0)
     print(f"total: {sent}")
+    print(
+        f"verdicts: accepted={accepted} "
+        f"shed={counts['shed']} "
+        f"duplicate={counts.get('duplicate', 0)} "
+        f"already_committed={counts.get('already_committed', 0)} "
+        f"oversized={counts.get('oversized', 0)} "
+        f"(backoffs={counts['backoffs']})"
+    )
+    if sent:
+        print(f"shed rate: {counts['shed'] / sent:.3f}")
     return 0
 
 

@@ -44,6 +44,13 @@ benchdag:
 benchdagsmoke:
 	JAX_PLATFORMS=cpu python bench.py --dag --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d.get('consensus_match') is True, d; assert d['incremental']['stage_ms_per_sweep'], d; print('benchdagsmoke ok: snapshot', str(d['speedup_snapshot']) + 'x,', 'rebuilds', d['incremental']['rebuilds'])"
 
+# mempoolsmoke: seeded overload smoke — submit ≥10x the commit rate
+# against a small admission cap; asserts bounded pending, a nonzero shed
+# rate, no lost/duplicated accepted txs, and committed throughput held
+# near the non-overloaded baseline (docs/mempool.md)
+mempoolsmoke:
+	JAX_PLATFORMS=cpu python bench.py --mempool --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['shed_rate'] and d['shed_rate'] > 0, d; assert not d['cap_exceeded'], d; assert d['accepted_lost'] == 0, d; assert d['accepted_dup_commits'] == 0, d; assert d['overload_ratio'] and d['overload_ratio'] > 0.5, d; print('mempoolsmoke ok: shed_rate', d['shed_rate'], 'ratio', d['overload_ratio'])"
+
 # chaossmoke: short-budget nemesis soak — 10% drop + duplication +
 # partition/heal on a 5-node in-mem cluster, plus the bounded
 # shutdown/leave-under-partition checks; deterministic under
@@ -61,4 +68,4 @@ chaossoak:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke chaossmoke chaossoak wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak wheel

@@ -216,10 +216,11 @@ def test_add_transaction_rides_next_head():
         # background worker can't race us for the submit queue
         nodes[1].run_async(gossip=False)
         message = b"Hello World!"
-        proxies[0].submit_tx(message)
-        # drain the proxy's submit channel into the node's pool the way the
-        # background worker would (node.py doBackgroundWork loop)
-        nodes[0]._add_transaction(nodes[0].submit_q.get(timeout=1))
+        # submit_tx is synchronous admission now: the proxy hands the tx
+        # straight to node0's mempool (docs/mempool.md) and returns the
+        # verdict — no background worker involved
+        assert proxies[0].submit_tx(message) == "accepted"
+        assert nodes[0].core.mempool.pending_count == 1
         with nodes[0].core_lock:
             known = nodes[0].core.known_events()
         peer1 = next(
