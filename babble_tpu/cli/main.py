@@ -55,6 +55,9 @@ _RUN_FLAGS = {
     "mempool_event_max_bytes": ("mempool_event_max_bytes", int),
     "mempool_rate": ("mempool_rate", float),
     "submit_batch": ("submit_batch", int),
+    "sentry_threshold": ("sentry_threshold", float),
+    "sentry_quarantine": ("sentry_quarantine_s", float),
+    "sentry_decay_halflife": ("sentry_decay_halflife_s", float),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
@@ -292,6 +295,19 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--submit-batch", dest="submit_batch", type=int, default=None,
         help="submit-queue transactions drained per background pass",
+    )
+    run.add_argument(
+        "--sentry-threshold", dest="sentry_threshold", type=float,
+        default=None,
+        help="misbehavior score at which a peer is quarantined",
+    )
+    run.add_argument(
+        "--sentry-quarantine", dest="sentry_quarantine", type=float,
+        default=None, help="quarantine duration in seconds",
+    )
+    run.add_argument(
+        "--sentry-decay-halflife", dest="sentry_decay_halflife", type=float,
+        default=None, help="misbehavior score decay half-life in seconds",
     )
     run.add_argument(
         "--signal", action="store_true",

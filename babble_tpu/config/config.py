@@ -17,6 +17,13 @@ DEFAULT_BADGER_DIR = "badger_db"
 DEFAULT_PEERS_FILE = "peers.json"
 DEFAULT_GENESIS_PEERS_FILE = "peers.genesis.json"
 
+# Sentry defaults — the single source of truth, shared by the Config
+# fields below and Sentry.__init__ (node/sentry.py) so a Core built
+# without an injected sentry can't drift from the configured tuning.
+DEFAULT_SENTRY_THRESHOLD = 8.0
+DEFAULT_SENTRY_QUARANTINE_S = 30.0
+DEFAULT_SENTRY_DECAY_HALFLIFE_S = 30.0
+
 
 def default_data_dir() -> str:
     """~/.babble equivalent (reference: config/config.go:287-297)."""
@@ -85,6 +92,16 @@ class Config:
     # Submit-queue drain batch per background pass: bounded so a flood of
     # submissions can't starve transport RPC handling in the same loop.
     submit_batch: int = 256
+
+    # Sentry (docs/robustness.md §Byzantine fault model): classified
+    # ingest rejections add per-cause weights to the sender's misbehavior
+    # score; crossing `threshold` triggers a `quarantine_s` time-box
+    # (selector skips the peer, inbound syncs refused), after which the
+    # peer is re-admitted with a clean score. Scores decay with half-life
+    # `decay_halflife_s`, so only sustained abuse accumulates.
+    sentry_threshold: float = DEFAULT_SENTRY_THRESHOLD
+    sentry_quarantine_s: float = DEFAULT_SENTRY_QUARANTINE_S
+    sentry_decay_halflife_s: float = DEFAULT_SENTRY_DECAY_HALFLIFE_S
 
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory

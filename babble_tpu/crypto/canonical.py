@@ -105,6 +105,13 @@ def canonical_loads(data: bytes) -> Any:
     return json.loads(data.decode("utf-8"))
 
 
+def jsonable(obj: Any) -> Any:
+    """Canonical-normalize (bytes → b64, sorted keys) into plain JSON
+    types — the one helper behind every HTTP payload and evidence record
+    that must round-trip through json.dumps."""
+    return json.loads(canonical_dumps(obj))
+
+
 def b64(data: bytes) -> str:
     return base64.b64encode(data).decode("ascii")
 

@@ -14,7 +14,16 @@ from babble_tpu.hashgraph.caches import (
     PendingRoundsCache,
     SigPool,
 )
-from babble_tpu.hashgraph.errors import SelfParentError, is_normal_self_parent_error
+from babble_tpu.hashgraph.errors import (
+    ForkError,
+    HashgraphError,
+    InvalidSignatureError,
+    SelfParentError,
+    UnknownParentError,
+    UnknownParticipantError,
+    classify_rejection,
+    is_normal_self_parent_error,
+)
 from babble_tpu.hashgraph.event import (
     BlockSignature,
     Event,
@@ -72,6 +81,12 @@ __all__ = [
     "RoundEvent",
     "RoundInfo",
     "SelfParentError",
+    "ForkError",
+    "HashgraphError",
+    "InvalidSignatureError",
+    "UnknownParentError",
+    "UnknownParticipantError",
+    "classify_rejection",
     "SigPool",
     "Store",
     "TransactionType",

@@ -1,17 +1,122 @@
-"""Engine errors (reference: src/hashgraph/errors.go:1-32)."""
+"""Engine errors (reference: src/hashgraph/errors.go:1-32).
+
+Every rejection on the sync/ingest path raises a typed error carrying a
+``cause`` slug so the node's sentry (node/sentry.py) can classify
+misbehavior without string-matching messages. The slugs are stable — they
+become per-cause counters in ``get_stats`` and keys in the sentry's
+scoring table (docs/robustness.md §Byzantine fault model).
+"""
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING, Optional
 
-class SelfParentError(Exception):
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from babble_tpu.hashgraph.event import Event
+
+
+class HashgraphError(Exception):
+    """Base for classified ingest rejections. ``cause`` is the stable
+    classification slug consumed by the sentry."""
+
+    cause = "hashgraph"
+
+
+class SelfParentError(HashgraphError):
     """Raised when an event's self-parent is not the creator's last known
     event. ``normal=True`` marks the benign concurrent-duplicate-insert race
     that must be tolerated, not reported (reference: errors.go:3-32)."""
+
+    cause = "self_parent"
 
     def __init__(self, msg: str, normal: bool):
         super().__init__(msg)
         self.normal = normal
 
 
+class InvalidSignatureError(HashgraphError, ValueError):
+    """The event's creator signature (or an internal transaction's
+    signature) does not verify — a forged or wrong-key event. Replaces the
+    bare ValueError the insert path used to raise (still a ValueError for
+    callers predating the typed hierarchy), so the sentry can score
+    wrong-key floods without parsing messages.
+
+    Carries the rejected event when the raiser has it: a signature
+    failure is ambiguous after an observed fork (an honest event whose
+    parent hash resolves to the OTHER branch on this node re-hashes
+    differently and fails verification through no fault of the sender),
+    and the sentry uses the event's parent creator-ids to recognize that
+    case before scoring."""
+
+    cause = "invalid_signature"
+
+    def __init__(self, msg: str, event: Optional["Event"] = None):
+        super().__init__(msg)
+        self.event = event
+
+
+class UnknownParticipantError(HashgraphError, ValueError):
+    """A wire event references a creator id absent from the repertoire —
+    either garbage or a peer lying about membership. Subclasses ValueError
+    for compatibility with callers that predate the typed hierarchy."""
+
+    cause = "unknown_creator"
+
+
+class UnknownParentError(HashgraphError, ValueError):
+    """The event's other-parent is not in the store (an out-of-order or
+    fabricated reference)."""
+
+    cause = "unknown_parent"
+
+
+class ForkError(HashgraphError):
+    """Equivocation: a *signed* event arrived at an already-occupied
+    (creator, index) slot with a different hash. Both branches are
+    cryptographically attributable to the creator — the pair IS the
+    evidence (Baird 2016 §forks; the accountability line of work à la
+    BFT forensics records exactly such signed conflict pairs).
+
+    Carries both events so the sentry can mint a durable
+    :class:`~babble_tpu.node.sentry.EquivocationProof` before the insert
+    is refused. ``existing`` is the locally stored branch, ``incoming``
+    the rejected one; ``incoming``'s signature was verified before this
+    was raised (insert_event checks signatures first)."""
+
+    cause = "fork"
+
+    def __init__(
+        self,
+        creator: str,
+        index: int,
+        existing: Optional["Event"],
+        incoming: "Event",
+    ):
+        super().__init__(
+            f"fork detected: creator {creator[:16]}… already has a "
+            f"different event at index {index}"
+        )
+        self.creator = creator
+        self.index = index
+        self.existing = existing
+        self.incoming = incoming
+
+
 def is_normal_self_parent_error(err: object) -> bool:
     return isinstance(err, SelfParentError) and err.normal
+
+
+def classify_rejection(err: object) -> Optional[str]:
+    """Map an exception from the sync/ingest path to its misbehavior
+    cause slug, or None when the failure is not attributable to the peer
+    (local store trouble, benign duplicate races, transport errors).
+
+    SelfParentError is never attributed: normal=True is the benign
+    concurrent-duplicate race, and normal=False wraps a LOCAL store
+    error from last_event_from — blaming the sender for the receiver's
+    own store trouble would let a DB fault quarantine honest peers."""
+    if isinstance(err, SelfParentError):
+        return None
+    if isinstance(err, HashgraphError):
+        return err.cause
+    return None

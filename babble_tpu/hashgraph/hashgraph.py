@@ -20,6 +20,7 @@ Reference mapping (file:line into /root/reference/src/hashgraph/hashgraph.go):
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable, Dict, List, Optional
 
 from babble_tpu.common.errors import StoreError, StoreErrorKind, is_store_err
@@ -28,7 +29,11 @@ from babble_tpu.common.utils import median_int
 from babble_tpu.hashgraph.block import Block
 from babble_tpu.hashgraph.caches import PendingRound, PendingRoundsCache, SigPool
 from babble_tpu.hashgraph.errors import (
+    ForkError,
+    InvalidSignatureError,
     SelfParentError,
+    UnknownParentError,
+    UnknownParticipantError,
     is_normal_self_parent_error,
 )
 from babble_tpu.hashgraph.event import (
@@ -53,6 +58,10 @@ ROOT_DEPTH = 10
 
 # Frequency of coin rounds in the fame decision (reference: hashgraph.go:24-25).
 COIN_ROUND_FREQ = 4
+
+# Verbose per-event rejection logging, resolved once at import: the old
+# per-reject `import os` + env read sat inside the hot insert path.
+_DEBUG_REJECTS = bool(os.environ.get("BABBLE_DEBUG_REJECTS"))
 
 # InternalCommitCallback: commits a block; the node's core layer processes
 # the commit response (reference: hashgraph.go:1677-1688).
@@ -308,7 +317,21 @@ class Hashgraph:
 
     def _check_self_parent(self, event: Event) -> None:
         """The self-parent must be the creator's last known event — this is
-        what structurally prevents forks (reference: hashgraph.go:405-429)."""
+        what structurally prevents forks (reference: hashgraph.go:405-429).
+
+        On a mismatch, the occupied (creator, index) slot distinguishes
+        three cases the reference folds into one "normal" error:
+
+        - same hash at the slot → a benign concurrent duplicate insert;
+        - a DIFFERENT hash at the slot → equivocation. The incoming
+          event's signature was already verified (insert_event checks it
+          first), and the stored branch was verified at its own insert,
+          so the pair is cryptographic proof of a fork — raised as
+          :class:`ForkError` carrying both events for the sentry;
+        - empty slot (index gap / stale parent) → the benign race.
+
+        The reference dropped the second branch silently and kept
+        gossiping with the attacker; here the evidence surfaces."""
         self_parent = event.self_parent()
         creator = event.creator()
         try:
@@ -318,6 +341,18 @@ class Hashgraph:
                 return  # first event
             raise SelfParentError(str(err), normal=False)
         if self_parent != creator_last_known:
+            occupant = None
+            try:
+                occupant = self.store.participant_event(creator, event.index())
+            except StoreError:
+                pass
+            if occupant is not None and occupant != event.hex():
+                existing = None
+                try:
+                    existing = self.store.get_event(occupant)
+                except StoreError:
+                    pass
+                raise ForkError(creator, event.index(), existing, event)
             # Expected under concurrent duplicate inserts — a "normal" error
             # (reference: errors.go:24-32, hashgraph.go:419-428).
             raise SelfParentError(
@@ -331,7 +366,7 @@ class Hashgraph:
             try:
                 self.store.get_event(other_parent)
             except StoreError:
-                raise ValueError("other-parent not known")
+                raise UnknownParentError("other-parent not known")
 
     def _init_event_coordinates(self, event: Event) -> None:
         """lastAncestors = element-wise max of parents' lastAncestors;
@@ -404,7 +439,9 @@ class Hashgraph:
 
         creator = self.store.repertoire_by_pub_key().get(event.creator())
         if creator is None:
-            raise ValueError(f"creator {event.creator()} not found")
+            raise UnknownParticipantError(
+                f"creator {event.creator()} not found"
+            )
 
         if event.self_parent() != "":
             self_parent_index = self.store.get_event(event.self_parent()).index()
@@ -413,7 +450,9 @@ class Hashgraph:
             other_parent = self.store.get_event(event.other_parent())
             op_creator = self.store.repertoire_by_pub_key().get(other_parent.creator())
             if op_creator is None:
-                raise ValueError(f"creator {other_parent.creator()} not found")
+                raise UnknownParticipantError(
+                    f"creator {other_parent.creator()} not found"
+                )
             other_parent_creator_id = op_creator.id
             other_parent_index = other_parent.index()
 
@@ -477,9 +516,7 @@ class Hashgraph:
         """Verify signature, check parents, prevent forks, maintain
         coordinates, queue for consensus (reference: hashgraph.go:672-750)."""
         if not event.verify():
-            import os
-
-            if os.environ.get("BABBLE_DEBUG_REJECTS"):
+            if _DEBUG_REJECTS:
                 logger.error(
                     "REJECT %s creator=%s idx=%s parents=%r txs=%d itxs=%d "
                     "sigs=%d ts=%s sig=%s",
@@ -490,7 +527,9 @@ class Hashgraph:
                     len(event.body.block_signatures),
                     event.body.timestamp, event.signature[:40],
                 )
-            raise ValueError(f"invalid event signature {event.hex()}")
+            raise InvalidSignatureError(
+                f"invalid event signature {event.hex()}", event=event
+            )
 
         self._check_self_parent(event)
         self._check_other_parent(event)
@@ -1071,11 +1110,15 @@ class Hashgraph:
                     h = overlay.get((pub_hex, idx))
                     if h is not None:
                         return h
-                raise
+                raise UnknownParentError(
+                    f"parent ({pub_hex[:16]}…, {idx}) not known"
+                )
 
         creator = self.store.repertoire_by_id().get(wevent.body.creator_id)
         if creator is None:
-            raise ValueError(f"creator {wevent.body.creator_id} not found")
+            raise UnknownParticipantError(
+                f"creator {wevent.body.creator_id} not found"
+            )
         creator_bytes = creator.pub_key_bytes()
 
         if wevent.body.self_parent_index >= 0:
@@ -1088,7 +1131,7 @@ class Hashgraph:
                 wevent.body.other_parent_creator_id
             )
             if op_creator is None:
-                raise ValueError(
+                raise UnknownParticipantError(
                     f"participant {wevent.body.other_parent_creator_id} not found"
                 )
             other_parent = resolve(

@@ -44,6 +44,7 @@ CREATE TABLE IF NOT EXISTS blocks (idx INTEGER PRIMARY KEY, data TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS frames (round INTEGER PRIMARY KEY, data TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS peer_sets (round INTEGER PRIMARY KEY, data TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS roots (participant TEXT PRIMARY KEY, data TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS evidence (key TEXT PRIMARY KEY, data TEXT NOT NULL);
 """
 
 
@@ -360,6 +361,36 @@ class PersistentStore:
     def db_last_block_index(self) -> int:
         row = self._fetch("SELECT MAX(idx) FROM blocks", ())
         return row[0] if row and row[0] is not None else -1
+
+    # -- evidence ------------------------------------------------------------
+
+    def set_evidence(self, key: str, data: dict) -> None:
+        """Durable misbehavior evidence (equivocation proofs): written
+        through even in maintenance mode — evidence is NOT derived state
+        that a bootstrap replay rebuilds, so the replay's write gate
+        (which protects events/rounds/blocks from being re-written) must
+        not silently drop a proof recorded while it is open."""
+        self._inmem.set_evidence(key, data)
+        with self._db_lock:
+            if self._db is None:
+                raise StoreError(
+                    "PersistentStore", StoreErrorKind.CLOSED, "evidence"
+                )
+            self._db.execute(
+                "INSERT OR REPLACE INTO evidence (key, data) VALUES (?, ?)",
+                (key, canonical_dumps(data).decode()),
+            )
+            self._db.commit()
+
+    def all_evidence(self) -> Dict[str, dict]:
+        with self._db_lock:
+            if self._db is None:
+                return self._inmem.all_evidence()
+            rows = self._db.execute("SELECT key, data FROM evidence").fetchall()
+        out = dict(self._inmem.all_evidence())
+        for key, data in rows:
+            out[key] = json.loads(data)
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -55,6 +55,10 @@ class Store(Protocol):
     def reset(self, frame: Frame) -> None: ...
     def close(self) -> None: ...
     def store_path(self) -> str: ...
+    # Misbehavior evidence (equivocation proofs — node/sentry.py): a flat
+    # key -> jsonable-dict ledger, durable on persistent stores.
+    def set_evidence(self, key: str, data: dict) -> None: ...
+    def all_evidence(self) -> Dict[str, dict]: ...
 
 
 class InmemStore:
@@ -75,6 +79,10 @@ class InmemStore:
         self._last_round = -1
         self._last_consensus_events: Dict[str, str] = {}
         self._last_block = -1
+        # Equivocation evidence (node/sentry.py) — in-memory only here;
+        # deliberately NOT an LRU: proofs are tiny, rare, and must never
+        # be evicted while the process lives.
+        self._evidence: Dict[str, dict] = {}
 
     def cache_size(self) -> int:
         return self._cache_size
@@ -247,6 +255,16 @@ class InmemStore:
         for round, ps in frame.peer_sets.items():
             self.set_peer_set(round, PeerSet(ps))
         self.set_frame(frame)
+        # evidence survives resets: a fast-forward must not amnesty an
+        # equivocator
+
+    # -- evidence ----------------------------------------------------------
+
+    def set_evidence(self, key: str, data: dict) -> None:
+        self._evidence[key] = data
+
+    def all_evidence(self) -> Dict[str, dict]:
+        return dict(self._evidence)
 
     def close(self) -> None:
         pass

@@ -14,7 +14,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..hashgraph.block import Block
-from ..hashgraph.errors import is_normal_self_parent_error
+from ..hashgraph.errors import ForkError, is_normal_self_parent_error
 from ..hashgraph.event import Event, WireEvent, sort_topological
 from ..hashgraph.frame import Frame
 from ..hashgraph.hashgraph import Hashgraph
@@ -28,6 +28,7 @@ from ..mempool import Mempool
 from ..peers.peer_set import PeerSet
 from .peer_selector import RandomPeerSelector
 from .promise import JoinPromise
+from .sentry import Sentry
 from .validator import Validator
 
 logger = logging.getLogger(__name__)
@@ -69,6 +70,7 @@ class Core:
         accelerated_verify: bool = False,
         accelerator_mesh: int = 0,
         mempool: Optional[Mempool] = None,
+        sentry: Optional[Sentry] = None,
     ):
         # Gate the TPU batch-verify path behind a flag (the reference's
         # north-star `--accelerator` switch); jax is only imported when on.
@@ -82,7 +84,16 @@ class Core:
         self.genesis_peers = genesis_peers
         self.validators = genesis_peers
         self.peers = peers
-        self.peer_selector = RandomPeerSelector(peers, validator.id())
+        # Misbehavior ledger (node/sentry.py): classified ingest
+        # rejections score peers toward time-boxed quarantine; the
+        # selector skips quarantined ids via the hook below.
+        self.sentry = sentry if sentry is not None else Sentry()
+        self.sentry.set_peer_count(len(peers.peers))
+        self.peer_selector = RandomPeerSelector(
+            peers,
+            validator.id(),
+            quarantine_check=self.sentry.is_quarantined,
+        )
         self.proxy_commit_callback = proxy_commit_callback
         self.maintenance_mode = maintenance_mode
 
@@ -118,6 +129,16 @@ class Core:
 
         self.hg = Hashgraph(store, self.commit)
         self.hg.init(genesis_peers)
+        # Fork evidence is scored against the *creator*, not the relaying
+        # peer — resolve its id through the live repertoire.
+        self.sentry.set_creator_resolver(
+            lambda pub_hex: (
+                p.id
+                if (p := self.hg.store.repertoire_by_pub_key().get(pub_hex))
+                is not None
+                else None
+            )
+        )
 
         if accelerated_verify:
             # The same flag gates the consensus offload: fame and
@@ -157,6 +178,7 @@ class Core:
         peers' health scores and backoff state across the rebuild, so a
         membership change doesn't amnesty every failing peer."""
         self.peers = ps
+        self.sentry.set_peer_count(len(ps.peers))
         self.peer_selector = RandomPeerSelector(
             ps, self.validator.id(), prior=self.peer_selector
         )
@@ -224,7 +246,12 @@ class Core:
                 ev = self.hg.read_wire_info(unknown_events[j], overlay)
             except Exception:
                 break
-            overlay[(ev.creator(), ev.index())] = ev.hex()
+            # first decode at a (creator, index) slot wins — mirroring
+            # insert semantics, where the first event to occupy a slot is
+            # the one that lands and a conflicting twin is refused; a
+            # hostile batch carrying both fork branches must not have the
+            # SECOND branch hijack later parent resolution.
+            overlay.setdefault((ev.creator(), ev.index()), ev.hex())
             decoded.append(ev)
             j += 1
         return decoded, j
@@ -299,10 +326,17 @@ class Core:
             raise ValueError("prepared sync does not match wire events")
         other_head: Optional[Event] = None
         n = len(unknown_events)
+        # Equivocations are skip-and-collect, not abort: a fork-holding
+        # honest peer's diff leads with its branch of the fork every
+        # round, and aborting there would permanently wedge ingestion of
+        # everything that peer exclusively holds. The first ForkError is
+        # re-raised AFTER the batch (and heads/consensus bookkeeping)
+        # completes, so the node's sentry still sees it.
+        fork_errs: List[ForkError] = []
 
         pos = len(prepared.decoded)
         for we, ev in zip(unknown_events[:pos], prepared.decoded):
-            other_head = self._ingest_one(we, ev, from_id, other_head)
+            other_head = self._ingest_one(we, ev, from_id, other_head, fork_errs)
 
         while pos < n:
             # Tail after a decode stall: re-run decode+batch-verify in
@@ -322,7 +356,7 @@ class Core:
                 j = pos + 1
 
             for we, ev in zip(unknown_events[pos:j], decoded):
-                other_head = self._ingest_one(we, ev, from_id, other_head)
+                other_head = self._ingest_one(we, ev, from_id, other_head, fork_errs)
             pos = j
 
         # Do not overwrite a non-empty head with an empty one
@@ -344,17 +378,28 @@ class Core:
         # above (device path; no-op on the oracle path).
         self.hg.flush_consensus()
 
+        if fork_errs:
+            raise fork_errs[0]
+
     def _ingest_one(
         self,
         we: WireEvent,
         ev: Event,
         from_id: int,
         other_head: Optional[Event],
+        fork_errs: Optional[List[ForkError]] = None,
     ) -> Optional[Event]:
         """Insert one decoded sync event and maintain the heads-merge
-        bookkeeping; returns the updated other-peer head."""
+        bookkeeping; returns the updated other-peer head. A ForkError is
+        collected into ``fork_errs`` (the insert is still refused) so
+        the batch continues past it — see Core.sync."""
         try:
             self.insert_event_and_run_consensus(ev, set_wire_info=False)
+        except ForkError as err:
+            if fork_errs is None:
+                raise
+            fork_errs.append(err)
+            return other_head
         except Exception as err:
             if is_normal_self_parent_error(err):
                 # Benign concurrent-duplicate-insert race.

@@ -3,10 +3,9 @@ debugging (reference: /root/reference/src/node/graph.go:8-127)."""
 
 from __future__ import annotations
 
-import json
 from typing import Dict, List
 
-from ..crypto.canonical import canonical_dumps
+from ..crypto.canonical import jsonable
 
 
 class Graph:
@@ -31,7 +30,7 @@ class Graph:
                 except Exception:
                     continue
                 evs[h] = {
-                    "Body": json.loads(canonical_dumps(ev.body.to_dict())),
+                    "Body": jsonable(ev.body.to_dict()),
                     "Signature": ev.signature,
                     "Round": ev.round,
                     "LamportTimestamp": ev.lamport_timestamp,
@@ -46,7 +45,7 @@ class Graph:
         for i in range(store.last_round() + 1):
             try:
                 out.append(
-                    json.loads(canonical_dumps(store.get_round(i).to_dict()))
+                    jsonable(store.get_round(i).to_dict())
                 )
             except Exception:
                 out.append(None)
@@ -59,7 +58,7 @@ class Graph:
         for i in range(store.last_block_index() + 1):
             try:
                 out.append(
-                    json.loads(canonical_dumps(store.get_block(i).to_dict()))
+                    jsonable(store.get_block(i).to_dict())
                 )
             except Exception:
                 out.append(None)

@@ -67,6 +67,7 @@ class Node(StateManager):
         self.conf = conf
         self.logger = conf.logger("node")
         from ..mempool import Mempool
+        from .sentry import Sentry
 
         self.core = Core(
             validator,
@@ -78,7 +79,11 @@ class Node(StateManager):
             accelerated_verify=conf.accelerator,
             accelerator_mesh=conf.accelerator_mesh,
             mempool=Mempool.from_config(conf),
+            sentry=Sentry.from_config(conf),
         )
+        # Equivocation proofs persist through the store's evidence table
+        # (and load back on restart) when the store supports it.
+        self.core.sentry.attach_store(store)
         # Instrumented core lock: get_stats surfaces total acquisition
         # wait (lock_wait_ms_total) so lock-shrinking work stays measured.
         self.core_lock = TimedLock()
@@ -107,6 +112,11 @@ class Node(StateManager):
             "fast_forward": 0,
             "join": 0,
         }
+        # Receiving-side sync_limit enforcement: batches above our own
+        # configured cap are truncated (both the eager-push handler and
+        # the pull response) — a hostile peer must not dictate how much
+        # we ingest per request.
+        self.sync_limit_truncations = 0
         # Joining-state backoff: consecutive join failures grow the retry
         # sleep exponentially (capped by conf.join_backoff_cap) so a node
         # stuck outside a partitioned cluster doesn't hammer dead peers.
@@ -351,13 +361,18 @@ class Node(StateManager):
                 for k, v in self.core.mempool.stats().items()
             }
         )
-        # Robustness surface: handler crash counters per RPC type, and the
-        # peer selector's health/backoff view of the network.
+        # Robustness surface: handler crash counters per RPC type, the
+        # peer selector's health/backoff view of the network, and the
+        # sentry's misbehavior/quarantine ledger.
         stats.update(
             {f"rpc_errors_{k}": str(v) for k, v in self.rpc_errors.items()}
         )
         stats.update(
             {k: str(v) for k, v in self.core.peer_selector.stats().items()}
+        )
+        stats["sync_limit_truncations"] = str(self.sync_limit_truncations)
+        stats.update(
+            {k: str(v) for k, v in self.core.sentry.stats().items()}
         )
         accel = self.core.hg.accel
         if accel is not None:
@@ -484,7 +499,16 @@ class Node(StateManager):
             transport_failure = True
             self.logger.debug("gossip transport error: %s", err)
         except Exception as err:
-            self.logger.warning("gossip error: %s", err)
+            # Classified ingest rejections (typed hashgraph errors) feed
+            # the sentry: the pull leg's events came from this peer, so
+            # hostile payloads score it (forks score their creator).
+            cause = self.core.sentry.observe_rejection(err, peer.id)
+            if cause is not None:
+                self.logger.warning(
+                    "gossip rejection from %d (%s): %s", peer.id, cause, err
+                )
+            else:
+                self.logger.warning("gossip error: %s", err)
         finally:
             # only NETWORK failures decay the peer's health/backoff; a
             # local error (the generic branch) isn't the peer's fault
@@ -499,6 +523,12 @@ class Node(StateManager):
         t0 = time.monotonic()
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
         self.timers.record("request_sync", time.monotonic() - t0)
+        if len(resp.events) > self.conf.sync_limit:
+            # We asked for at most sync_limit events; a bigger response
+            # means the peer ignored the negotiated cap.
+            resp.events = resp.events[: self.conf.sync_limit]
+            self.sync_limit_truncations += 1
+            self.core.sentry.record(peer.id, "oversized_sync")
         t0 = time.monotonic()
         # Lock-free ingest stage: decode + hash + one batch signature
         # verification happen BEFORE the core lock; the lock then only
@@ -538,9 +568,14 @@ class Node(StateManager):
         except Exception as err:
             if not is_normal_self_parent_error(err):
                 raise
-        t0 = time.monotonic()
-        self.core.process_sig_pool()
-        self.timers.record("process_sig_pool", time.monotonic() - t0)
+        finally:
+            # Always drain the sig pool: Core.sync defers a ForkError
+            # until after the batch's inserts complete, so the block
+            # signatures those events carried must not sit unprocessed
+            # behind the re-raise.
+            t0 = time.monotonic()
+            self.core.process_sig_pool()
+            self.timers.record("process_sig_pool", time.monotonic() - t0)
 
     # -- catching up --------------------------------------------------------
 
@@ -693,6 +728,14 @@ class Node(StateManager):
             return
 
         cmd = rpc.command
+        # Quarantined peers get no sync service: their pushes are the
+        # attack surface and their pulls only help them keep up. Join and
+        # fast-forward stay open (different identity/recovery paths).
+        if isinstance(cmd, (SyncRequest, EagerSyncRequest)):
+            if self.core.sentry.is_quarantined(cmd.from_id):
+                self.core.sentry.note_refused()
+                rpc.respond(None, f"peer {cmd.from_id} is quarantined")
+                return
         if isinstance(cmd, SyncRequest):
             self._process_sync_request(rpc, cmd)
         elif isinstance(cmd, EagerSyncRequest):
@@ -712,7 +755,9 @@ class Node(StateManager):
         try:
             with self.core_lock:
                 diff = self.core.event_diff(cmd.known)
-            limit = min(cmd.sync_limit, self.conf.sync_limit)
+            # clamp: a hostile negative sync_limit must not turn
+            # diff[:limit] into serve-almost-everything
+            limit = min(max(0, cmd.sync_limit), self.conf.sync_limit)
             if len(diff) > limit:
                 diff = diff[:limit]
             resp.events = self.core.to_wire(diff)
@@ -729,6 +774,20 @@ class Node(StateManager):
         """reference: node_rpc.go:180-203."""
         success = True
         err: Optional[str] = None
+        if len(cmd.events) > self.conf.sync_limit:
+            # Receiving-side cap: the requester-side truncation
+            # (node.py _push) is a courtesy honest peers extend; a
+            # hostile pusher ignores it, so the cap is enforced here
+            # too. Scoring only kicks in past 2x our limit: eager-push
+            # has no negotiation leg, so an honest peer configured with
+            # a larger --sync-limit would otherwise be punished for a
+            # pure config mismatch (the pull leg negotiates explicitly,
+            # so there any overshoot is scored).
+            egregious = len(cmd.events) > 2 * self.conf.sync_limit
+            cmd.events = cmd.events[: self.conf.sync_limit]
+            self.sync_limit_truncations += 1
+            if egregious:
+                self.core.sentry.record(cmd.from_id, "oversized_sync")
         try:
             # Same lock-shrink as _pull: the batch decode+verify stage
             # runs before the lock, the lock covers only the inserts.
@@ -737,7 +796,10 @@ class Node(StateManager):
                 self._sync(cmd.from_id, cmd.events, prepared)
         except Exception as e:
             success = False
-            self.rpc_errors["eager_sync"] += 1
+            cause = self.core.sentry.observe_rejection(e, cmd.from_id)
+            if cause is None:
+                # not the peer's fault — a genuine handler crash
+                self.rpc_errors["eager_sync"] += 1
             self.logger.debug(
                 "eager-sync handler error: %s", e, exc_info=True
             )
@@ -831,6 +893,19 @@ class Node(StateManager):
             "config": self.core.mempool.config(),
             "stats": self.core.mempool.stats(),
         }
+
+    def get_suspects(self) -> Dict[str, object]:
+        """/suspects service payload: the sentry's per-peer misbehavior
+        ledger + equivocation proofs, with peers annotated by moniker so
+        operators can tell who is who (docs/robustness.md)."""
+        body = self.core.sentry.suspects()
+        by_id = self.core.hg.store.repertoire_by_id()
+        for pid_s, entry in body["peers"].items():
+            peer = by_id.get(int(pid_s))
+            if peer is not None:
+                entry["moniker"] = peer.moniker
+                entry["pub_key"] = peer.pub_key_hex
+        return body
 
     def _log_stats(self) -> None:
         self.logger.debug("stats: %s", self.get_stats())

@@ -89,6 +89,7 @@ class RandomPeerSelector:
         probe_interval_s: float = 0.5,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
+        quarantine_check: Optional[Callable[[int], bool]] = None,
     ):
         self.peers = peer_set
         self.self_id = self_id
@@ -109,6 +110,8 @@ class RandomPeerSelector:
             probe_interval_s = prior.probe_interval_s
             clock = prior._clock
             rng = prior._rng
+            if quarantine_check is None:
+                quarantine_check = prior._quarantine_check
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.backoff_jitter = backoff_jitter
@@ -118,6 +121,11 @@ class RandomPeerSelector:
         self.probe_interval_s = probe_interval_s
         self._clock = clock
         self._rng = rng if rng is not None else random.Random()
+        # Sentry hook (node/sentry.py): while a peer is quarantined for
+        # misbehavior it is excluded from gossip picks entirely — unlike
+        # health backoff there is no probe trickle; the peer is
+        # re-admitted only when the sentry's time-box expires.
+        self._quarantine_check = quarantine_check
         self._health: Dict[int, _Health] = {}
         for pid in self._selectable:
             carried = prior._health.get(pid) if prior is not None else None
@@ -126,6 +134,8 @@ class RandomPeerSelector:
         self.backoff_skips = 0  # picks where ≥1 peer sat out a backoff
         self.probe_picks = 0  # picks that were forced probes
         self.starvation_overrides = 0  # all-backed-off liveness picks
+        self.quarantine_skips = 0  # picks where ≥1 peer sat out a quarantine
+        self.quarantine_overrides = 0  # all-quarantined liveness picks
 
     def get_peers(self) -> PeerSet:
         return self.peers
@@ -175,6 +185,20 @@ class RandomPeerSelector:
             ids = list(self._selectable.keys())
             if not ids:
                 return None
+            if self._quarantine_check is not None:
+                # Quarantined peers are hard-excluded (no probe trickle)
+                # while ANY non-quarantined peer exists — but with the
+                # same liveness floor as the backoff path: an
+                # all-quarantined view means framing (the sentry caps
+                # honest quarantines at the BFT f bound) or gross
+                # misconfiguration, and gossip must keep trying SOMEONE.
+                open_ids = [i for i in ids if not self._quarantine_check(i)]
+                if len(open_ids) < len(ids):
+                    self.quarantine_skips += 1
+                if not open_ids:
+                    self.quarantine_overrides += 1
+                else:
+                    ids = open_ids
             if len(ids) == 1:
                 return self._selectable[ids[0]]
             candidates = [i for i in ids if i != self.last] or ids
@@ -246,6 +270,8 @@ class RandomPeerSelector:
                 "selector_backoff_skips": self.backoff_skips,
                 "selector_probe_picks": self.probe_picks,
                 "selector_starvation_overrides": self.starvation_overrides,
+                "selector_quarantine_skips": self.quarantine_skips,
+                "selector_quarantine_overrides": self.quarantine_overrides,
             }
 
     def health_of(self, peer_id: int) -> Optional[_Health]:

@@ -20,7 +20,7 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..crypto.canonical import canonical_dumps, unb64
+from ..crypto.canonical import canonical_dumps, jsonable, unb64
 from ..hashgraph.block import Block
 from ..hashgraph.internal_transaction import InternalTransactionReceipt
 from .proxy import CommitResponse, ProxyHandler
@@ -195,7 +195,7 @@ class SocketAppProxy:
 
     def commit_block(self, block: Block) -> CommitResponse:
         result = self._client.call(
-            "State.CommitBlock", json.loads(canonical_dumps(block.to_dict()))
+            "State.CommitBlock", jsonable(block.to_dict())
         )
         return CommitResponse(
             state_hash=unb64(result["StateHash"]) if result["StateHash"] else b"",
@@ -211,7 +211,7 @@ class SocketAppProxy:
 
     def restore(self, snapshot: bytes) -> None:
         self._client.call(
-            "State.Restore", json.loads(canonical_dumps(snapshot))
+            "State.Restore", jsonable(snapshot)
         )
 
     def on_state_changed(self, state) -> None:
@@ -266,7 +266,7 @@ class SocketBabbleProxy:
 
     def _get_snapshot(self, block_index: int):
         snap = self._handler.snapshot_handler(block_index)
-        return json.loads(canonical_dumps(snap))
+        return jsonable(snap)
 
     def _restore(self, snapshot_b64: str):
         self._handler.restore_handler(unb64(snapshot_b64) if snapshot_b64 else b"")
@@ -283,7 +283,7 @@ class SocketBabbleProxy:
         shaped peer (or a proxy with no node attached) answers ``true`` —
         mapped to "accepted" so callers see one vocabulary."""
         result = self._client.call(
-            "Babble.SubmitTx", json.loads(canonical_dumps(tx))
+            "Babble.SubmitTx", jsonable(tx)
         )
         return "accepted" if result is True else str(result)
 

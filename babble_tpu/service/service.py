@@ -15,14 +15,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from ..crypto.canonical import canonical_dumps
+from ..crypto.canonical import jsonable as _jsonable
 from ..node.graph import Graph
 
 GET_BLOCKS_LIMIT = 50  # max blocks per /blocks/ page (service.go:126)
-
-
-def _jsonable(obj) -> object:
-    return json.loads(canonical_dumps(obj))
 
 
 class Service:
@@ -77,6 +73,10 @@ class Service:
             elif path == "/mempool":
                 # admission knobs + live counters (docs/mempool.md)
                 body = self.node.get_mempool()
+            elif path == "/suspects":
+                # sentry misbehavior ledger + equivocation proofs
+                # (docs/robustness.md §Byzantine fault model)
+                body = self.node.get_suspects()
             elif path.startswith("/block/"):
                 body = _jsonable(
                     self.node.get_block(int(path[len("/block/"):])).to_dict()

@@ -9,6 +9,16 @@ retry instead of hammering a shedding node; retries exhausted count as
 shed. Totals (accepted / shed / duplicate / ...) print at exit.
 
 Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
+
+Byzantine mode — drive the adversary harness (babble_tpu.adversary)
+against a live cluster outside pytest: point it at a compromised
+validator's datadir (priv_key + peers.json — stop that node first, the
+adversary takes over its identity and gossip address) and pick an attack
+from the catalog (docs/robustness.md). Watch any honest node's
+``/suspects`` endpoint to see the quarantine land.
+
+Usage:  python demo/bombard.py --byzantine=equivocate --datadir=<dir>
+                               [--duration=20] [--listen=host:port]
 """
 
 from __future__ import annotations
@@ -45,14 +55,74 @@ def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> None:
         return
 
 
+def run_byzantine(
+    attack: str, datadir: str, duration: float, listen: str = ""
+) -> int:
+    """Spawn one ByzantineNode with the compromised validator's identity
+    and let it attack the live cluster for ``duration`` seconds."""
+    from babble_tpu.adversary import ATTACKS, ByzantineNode
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keyfile import SimpleKeyfile
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.json_peer_set import JSONPeerSet
+
+    if attack not in ATTACKS:
+        print(f"unknown attack {attack!r}; pick from {ATTACKS}", file=sys.stderr)
+        return 2
+    key = SimpleKeyfile(os.path.join(datadir, "priv_key")).read_key()
+    peers = JSONPeerSet(datadir).peer_set()
+    me = peers.by_pub_key.get(key.public_key.hex())
+    if me is None:
+        print("this key is not in peers.json — the adversary must own a "
+              "validator identity", file=sys.stderr)
+        return 2
+    bind = listen or me.net_addr
+    conf = Config(data_dir=datadir, moniker=f"byz-{me.moniker}")
+    trans = TCPTransport(
+        bind, max_pool=conf.max_pool, timeout=conf.tcp_timeout,
+        join_timeout=conf.join_timeout,
+    )
+    byz = ByzantineNode(
+        conf, Validator(key, f"byz-{me.moniker}"), peers, peers,
+        InmemStore(conf.cache_size), trans, attack=attack,
+    )
+    print(f"byzantine[{attack}] as {me.moniker} on {bind} "
+          f"for {duration:.0f}s ...")
+    byz.run_async()
+    try:
+        time.sleep(duration)
+    except KeyboardInterrupt:
+        pass
+    byz.stop()
+    for k, v in byz.stats().items():
+        print(f"{k}: {v}")
+    return 0
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(args[0]) if len(args) > 0 else 4
     m = int(args[1]) if len(args) > 1 else 100
     base_port = 13000
+    opts = {}
     for a in sys.argv[1:]:
         if a.startswith("--base-port"):
             base_port = int(a.split("=", 1)[1])
+        elif a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            opts[k] = v
+
+    if "byzantine" in opts:
+        if "datadir" not in opts:
+            print("--byzantine needs --datadir=<dir> (priv_key + peers.json)",
+                  file=sys.stderr)
+            return 2
+        return run_byzantine(
+            opts["byzantine"], opts["datadir"],
+            float(opts.get("duration", "20")), opts.get("listen", ""),
+        )
 
     counts: dict = {"shed": 0, "backoffs": 0}
     sent = 0

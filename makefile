@@ -63,9 +63,22 @@ chaossmoke:
 chaossoak:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m "chaos"
 
+# byzsmoke: short seeded honest-vs-Byzantine soak — 4 honest + 1
+# equivocating node under chaos drop; asserts identical honest chains
+# past the attack window, quarantine with a verifiable equivocation
+# proof, proof persistence across --store --bootstrap restart, and
+# receiving-side sync_limit caps (docs/robustness.md §Byzantine fault
+# model). The f=⌊(N−1)/3⌋ storm stays behind -m slow.
+byzsmoke:
+	JAX_PLATFORMS=cpu BABBLE_CHAOS_SEED=42 python -m pytest tests/test_byzantine.py -q -m "byz and not slow"
+
+# byzstorm: the full storm (two simultaneous adversaries under chaos)
+byzstorm:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_byzantine.py -q -m "byz"
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm wheel

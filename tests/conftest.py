@@ -49,6 +49,12 @@ def pytest_configure(config):
         "BABBLE_CHAOS_SEED; short ones run in tier-1 / make chaossmoke, "
         "the long nemesis storm is also marked slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "byz: honest-vs-Byzantine soaks (seeded; short ones run in "
+        "tier-1 / make byzsmoke, the f=⌊(N−1)/3⌋ storm is also marked "
+        "slow)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,

@@ -4,17 +4,31 @@ rejected cleanly without poisoning the node or stalling the cluster.
 The reference relies on the same layered defences (wire decode errors,
 signature verification at insert, fork checks — hashgraph.go:672-750,
 node_rpc.go:180-203); these tests drive them through a live node's RPC
-surface the way an attacker could.
+surface the way an attacker could. On top of the reference's refusals,
+the sentry layer (node/sentry.py) is exercised here: classified
+rejections score the sender toward time-boxed quarantine, equivocations
+mint durable proofs that survive a restart through the store's evidence
+table, and receiving-side sync_limit caps bound what a hostile pusher
+can make us ingest (docs/robustness.md §Byzantine fault model).
 """
 
 from __future__ import annotations
 
 import time
 
+import pytest
+
 from babble_tpu.crypto.keys import generate_key
+from babble_tpu.hashgraph.errors import ForkError
 from babble_tpu.hashgraph.event import Event, WireBody, WireEvent
+from babble_tpu.hashgraph.hashgraph import Hashgraph
+from babble_tpu.hashgraph.persistent_store import PersistentStore
 from babble_tpu.net.inmem import InmemNetwork
 from babble_tpu.net.rpc import RPC, EagerSyncRequest
+from babble_tpu.node.peer_selector import RandomPeerSelector
+from babble_tpu.node.sentry import EquivocationProof, Sentry
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
 
 from test_node import bombard_and_wait, check_gossip, make_cluster, shutdown_all
 
@@ -139,5 +153,416 @@ def test_cluster_survives_junk_flood_under_load():
         for bi in range(0, 3):
             for tx in nodes[0].get_block(bi).transactions():
                 assert not tx.startswith(b"junk"), "junk tx reached a block"
+    finally:
+        shutdown_all(nodes)
+
+
+# -- sentry: equivocation proofs ------------------------------------------
+
+
+def _forked_pair(key, peer_set, store):
+    """Insert one event, then raise ForkError with a conflicting twin at
+    the same (creator, index); returns the captured error."""
+    h = Hashgraph(store)
+    h.init(peer_set)
+    e0 = Event.new([b"first"], [], [], ["", ""], key.public_key.bytes(), 0)
+    e0.sign(key)
+    h.insert_event(e0)
+    twin = Event.new([b"second"], [], [], ["", ""], key.public_key.bytes(), 0)
+    twin.sign(key)
+    with pytest.raises(ForkError) as ei:
+        h.insert_event(twin)
+    return ei.value
+
+
+def test_equivocation_proof_roundtrip_survives_restart(tmp_path):
+    """Fork observed → proof recorded through the sentry → persisted via
+    the store's evidence table → loaded back by a fresh incarnation."""
+    key = generate_key()
+    peer_set = PeerSet([Peer("inmem://solo", key.public_key.hex(), "solo")])
+    db = str(tmp_path / "evidence.db")
+
+    store = PersistentStore(cache_size=100, path=db)
+    err = _forked_pair(key, peer_set, store)
+    assert err.existing is not None
+
+    sentry = Sentry()
+    sentry.attach_store(store)
+    cause = sentry.observe_rejection(err, from_id=42)
+    assert cause == "fork"
+    proofs = sentry.proofs()
+    assert len(proofs) == 1
+    assert proofs[0].verify(), "recorded proof must be independently verifiable"
+    assert sentry.is_quarantined(42), "a proven fork quarantines immediately"
+    store.close()
+
+    # fresh incarnation: evidence reloads from the DB, still verifiable
+    store2 = PersistentStore(cache_size=100, path=db)
+    sentry2 = Sentry()
+    sentry2.attach_store(store2)
+    reloaded = sentry2.proofs()
+    assert len(reloaded) == 1
+    assert reloaded[0].key() == proofs[0].key()
+    assert reloaded[0].verify()
+    a, b = reloaded[0].events()
+    assert a.creator() == b.creator() and a.index() == b.index()
+    assert a.hex() != b.hex()
+    store2.close()
+
+
+def test_proof_ledger_capped_per_creator():
+    """A serial forker (new conflicting pair at every height) must not
+    grow the durable proof ledger without bound: one pair is conclusive,
+    extras beyond MAX_PROOFS_PER_CREATOR are dropped."""
+    from babble_tpu.node.sentry import MAX_PROOFS_PER_CREATOR
+
+    key = generate_key()
+    sentry = Sentry()
+    for i in range(MAX_PROOFS_PER_CREATOR + 3):
+        a = Event.new([b"a"], [], [], ["", ""], key.public_key.bytes(), i)
+        b = Event.new([b"b"], [], [], ["", ""], key.public_key.bytes(), i)
+        a.sign(key)
+        b.sign(key)
+        added = sentry.add_proof(EquivocationProof.from_events(a, b))
+        assert added == (i < MAX_PROOFS_PER_CREATOR)
+    assert len(sentry.proofs()) == MAX_PROOFS_PER_CREATOR
+
+
+def test_proof_verify_rejects_tampering():
+    """A proof whose events do not actually conflict (or whose signatures
+    are forged) must fail verification."""
+    key = generate_key()
+    e = Event.new([b"x"], [], [], ["", ""], key.public_key.bytes(), 0)
+    e.sign(key)
+    same = EquivocationProof.from_events(e, e)
+    assert not same.verify()  # identical hashes: no conflict
+
+    other = Event.new([b"y"], [], [], ["", ""], key.public_key.bytes(), 0)
+    other.sign(generate_key())  # wrong key
+    forged = EquivocationProof.from_events(e, other)
+    assert not forged.verify()
+
+
+# -- sentry: scoring, quarantine expiry, selector integration -------------
+
+
+def test_quarantine_expiry_readmits_falsely_flagged_peer():
+    """A peer pushed over the threshold by transient junk serves its
+    time-box, then re-enters with a clean score — and the selector skips
+    it exactly while the quarantine is active."""
+    now = [0.0]
+    sentry = Sentry(
+        threshold=4.0, quarantine_s=10.0, decay_halflife_s=1e9,
+        clock=lambda: now[0],
+    )
+    peers = PeerSet(
+        [
+            Peer(f"inmem://n{i}", generate_key().public_key.hex(), f"n{i}")
+            for i in range(3)
+        ]
+    )
+    ids = [p.id for p in peers.peers]
+    sel = RandomPeerSelector(
+        peers, ids[0], quarantine_check=sentry.is_quarantined,
+        clock=lambda: now[0],
+    )
+
+    # two garbage strikes (weight 2 each) cross the threshold of 4
+    assert not sentry.record(ids[1], "garbage")
+    assert sentry.record(ids[1], "garbage")
+    assert sentry.is_quarantined(ids[1])
+    for _ in range(20):
+        pick = sel.next()
+        assert pick is not None and pick.id == ids[2], (
+            "selector must skip the quarantined peer"
+        )
+    assert sel.quarantine_skips > 0
+
+    # time serves the sentence: clean slate, re-admitted
+    now[0] = 10.5
+    assert not sentry.is_quarantined(ids[1])
+    assert sentry.suspects()["peers"][str(ids[1])]["score"] == 0.0
+    picked = {sel.next().id for _ in range(50)}
+    assert ids[1] in picked, "expired quarantine must re-admit the peer"
+    assert sentry.readmissions >= 1
+
+
+def test_framing_guard_caps_spoofable_quarantines_at_bft_f():
+    """from_id is spoofable, so unproven-cause quarantines are capped at
+    f = ⌊(N−1)/3⌋ simultaneously — a framing flood can sideline at most
+    f peers, never the cluster; signed fork evidence bypasses the cap,
+    and the selector keeps a liveness floor even if its whole view is
+    quarantined."""
+    now = [0.0]
+    sentry = Sentry(threshold=2.0, quarantine_s=30.0, clock=lambda: now[0])
+    sentry.set_peer_count(5)  # f = 1
+    assert sentry.record(1, "oversized_sync")  # weight 2 → quarantined
+    assert not sentry.record(2, "oversized_sync"), "cap reached: deferred"
+    assert sentry.is_quarantined(1) and not sentry.is_quarantined(2)
+    assert sentry.quarantine_deferrals == 1
+    # cryptographically proven misbehavior is never deferred
+    assert sentry.record(3, "fork")
+    assert sentry.is_quarantined(3)
+
+    # ...and a proven (fork) quarantine does not consume the cap: a
+    # quarantined equivocator must not shield a concurrent flooder
+    s2 = Sentry(threshold=2.0, quarantine_s=30.0, clock=lambda: now[0])
+    s2.set_peer_count(5)  # f = 1
+    assert s2.record(10, "fork")
+    assert s2.record(11, "oversized_sync"), (
+        "unproven quarantine budget must be free while only a "
+        "fork-proven peer is quarantined"
+    )
+    assert s2.is_quarantined(10) and s2.is_quarantined(11)
+
+    # selector liveness floor: everything quarantined → still picks
+    peers = PeerSet(
+        [
+            Peer(f"inmem://q{i}", generate_key().public_key.hex(), f"q{i}")
+            for i in range(3)
+        ]
+    )
+    ids = [p.id for p in peers.peers]
+    sel = RandomPeerSelector(
+        peers, ids[0], quarantine_check=lambda pid: True,
+        clock=lambda: now[0],
+    )
+    assert sel.next() is not None, "all-quarantined must not stall gossip"
+    assert sel.quarantine_overrides >= 1
+
+
+def test_invalid_signature_not_scored_when_fork_adjacent():
+    """After a fork is on file, a signature failure on an event whose
+    parent creators include the forker is ambiguous (cross-branch decode
+    mismatch) — the event is rejected and counted, but the relaying peer
+    is NOT scored; honest nodes on opposite fork branches must not
+    quarantine each other."""
+    from babble_tpu.hashgraph.errors import InvalidSignatureError
+
+    forker = generate_key()
+    sentry = Sentry()
+    a = Event.new([b"a"], [], [], ["", ""], forker.public_key.bytes(), 0)
+    b = Event.new([b"b"], [], [], ["", ""], forker.public_key.bytes(), 0)
+    a.sign(forker)
+    b.sign(forker)
+    sentry.add_proof(EquivocationProof.from_events(a, b))
+
+    honest = generate_key()
+    ev = Event.new(
+        [b"fine"], [], [], ["", a.hex()], honest.public_key.bytes(), 3
+    )
+    ev.sign(honest)
+    forker_id = 777
+    sentry.set_creator_resolver(
+        lambda pub: forker_id if pub == a.creator() else None
+    )
+    ev.body.other_parent_creator_id = forker_id
+
+    err = InvalidSignatureError("cross-branch mismatch", event=ev)
+    relayer = 555
+    assert sentry.observe_rejection(err, relayer) == "invalid_signature"
+    assert sentry.rejects.get("invalid_signature_fork_adjacent") == 1
+    assert sentry.suspects()["peers"].get(str(relayer)) is None, (
+        "fork-adjacent signature failures must not score the relayer"
+    )
+    # without fork adjacency the same error DOES score
+    plain = Event.new([b"x"], [], [], ["", ""], honest.public_key.bytes(), 0)
+    plain.sign(generate_key())
+    sentry.observe_rejection(
+        InvalidSignatureError("forged", event=plain), relayer
+    )
+    assert str(relayer) in sentry.suspects()["peers"]
+
+
+def test_fork_quarantine_without_evidence_is_not_proven():
+    """A ForkError whose stored branch was evicted (existing=None) still
+    quarantines the creator — but as an UNPROVEN entry that counts
+    toward the framing-guard f budget, since no verifiable proof landed
+    on file."""
+    from babble_tpu.hashgraph.errors import ForkError
+
+    key = generate_key()
+    twin = Event.new([b"b"], [], [], ["", ""], key.public_key.bytes(), 0)
+    twin.sign(key)
+    now = [0.0]
+    sentry = Sentry(threshold=2.0, clock=lambda: now[0])
+    sentry.set_peer_count(5)  # f = 1
+    err = ForkError(twin.creator(), 0, None, twin)
+    assert sentry.observe_rejection(err, from_id=9) == "fork"
+    assert sentry.is_quarantined(9)
+    assert not sentry.proofs()
+    # the evidence-less quarantine consumed the unproven budget
+    assert not sentry.record(10, "oversized_sync")
+    assert sentry.quarantine_deferrals == 1
+
+
+def test_misbehavior_ledger_bounded_under_id_rotation():
+    """from_id is attacker-controlled: a flood of offences under fresh
+    ids must not grow the ledger without bound — and pruning must never
+    evict a quarantined peer's record."""
+    from babble_tpu.node.sentry import MAX_RECORDS
+
+    now = [0.0]
+    sentry = Sentry(threshold=4.0, clock=lambda: now[0])
+    sentry.record(7, "fork")  # proven offender, quarantined
+    assert sentry.is_quarantined(7)
+    for i in range(1000, 1000 + MAX_RECORDS + 500):
+        sentry.record(i, "unknown_creator")
+    assert len(sentry._records) <= MAX_RECORDS
+    assert 7 in sentry._records and sentry.is_quarantined(7)
+
+
+def test_scores_decay_between_offences():
+    """Sparse offences are forgiven: the same strikes spread out over
+    several half-lives never reach the threshold."""
+    now = [0.0]
+    sentry = Sentry(
+        threshold=4.0, quarantine_s=10.0, decay_halflife_s=1.0,
+        clock=lambda: now[0],
+    )
+    for _ in range(10):
+        quarantined = sentry.record(5, "garbage")  # weight 2
+        assert not quarantined
+        now[0] += 5.0  # 5 half-lives: score ~0 before the next strike
+    assert not sentry.is_quarantined(5)
+
+
+def test_fork_in_batch_does_not_block_later_events():
+    """A fork mid-batch is skip-and-collect, not abort: the conflicting
+    event is refused and the ForkError surfaces AFTER the batch, but
+    every insertable event behind it still lands — a fork-holding peer's
+    diff (which leads with its branch every round) must not wedge
+    ingestion of everything that peer exclusively holds."""
+    from tests.test_core import init_cores
+
+    cores, _, _ = init_cores(2)
+    cores[0].add_self_event("")  # index 1 on top of the initial event
+    id0 = cores[0].validator.id()
+
+    diff = cores[0].event_diff(cores[1].known_events())
+    wires = list(cores[0].to_wire(diff))  # [e0@0, e0@1]
+    assert len(wires) == 2
+
+    # craft the fork: a signed twin of core0's index-0 event
+    twin = Event.new(
+        [b"twin"], [], [], ["", ""],
+        cores[0].validator.public_key_bytes(), 0,
+    )
+    twin.sign(cores[0].validator.key)
+    cores[0].hg.set_wire_info(twin)
+
+    batch = [wires[0], twin.to_wire(), wires[1]]
+    with pytest.raises(ForkError):
+        cores[1].sync(id0, batch)
+    # the event BEHIND the fork landed anyway
+    assert cores[1].known_events()[id0] == 1
+
+
+# -- receiving-side sync_limit enforcement --------------------------------
+
+
+def test_oversized_eager_sync_truncated_and_scored():
+    """An eager push beyond our configured sync_limit is capped at the
+    receiver: sync_limit_truncations moves, the pusher is scored, and a
+    sustained flood quarantines it."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(2, network)
+    try:
+        node = nodes[0]
+        node.run_async(gossip=False)
+        limit = node.conf.sync_limit
+
+        def junk_batch(n):
+            return [
+                WireEvent(
+                    body=WireBody(
+                        transactions=[b"owt"], creator_id=0xBEEF, index=i,
+                        self_parent_index=i - 1, other_parent_index=-1,
+                    ),
+                    signature="1|1",
+                )
+                for i in range(n)
+            ]
+
+        # mildly over the limit (an honest peer with a bigger
+        # --sync-limit looks like this): truncated + counted, NOT scored
+        resp, err = _eager(node, junk_batch(limit + 5))
+        assert err is not None  # junk still rejected after the cap
+        assert node.sync_limit_truncations == 1
+        assert node.get_stats()["sync_limit_truncations"] == "1"
+        assert node.core.sentry.rejects.get("oversized_sync") is None
+
+        # egregious (> 2x our limit): scored
+        huge = junk_batch(2 * limit + 5)
+        _eager(node, huge)
+        assert node.core.sentry.rejects.get("oversized_sync") == 1
+        # a sustained egregious flood crosses the threshold (2.0 per
+        # hit, default threshold 8) and lands the pusher in quarantine
+        for _ in range(4):
+            _eager(node, huge)
+        assert node.core.sentry.is_quarantined(999)
+        # ...at which point inbound syncs from it are refused outright
+        before = node.sync_limit_truncations
+        resp, err = _eager(node, huge)
+        assert err is not None and "quarantined" in err
+        assert node.sync_limit_truncations == before, (
+            "a quarantined peer's push must be refused before processing"
+        )
+        assert node.core.sentry.refused_rpcs >= 1
+    finally:
+        shutdown_all(nodes)
+
+
+def test_wrong_key_flood_drives_quarantine_without_stalling_gossip():
+    """Satellite: a flood of well-formed events signed by the WRONG key
+    (claiming a victim's identity) racks up invalid_signature scores on
+    the SENDER until it is quarantined — while the honest cluster keeps
+    committing and the victim is never penalized."""
+    network = InmemNetwork()
+    nodes, proxies, _ = make_cluster(3, network)
+    try:
+        for n in nodes:
+            n.run_async()
+        victim = next(iter(nodes[0].core.peers.peers))
+        mallory_id = 424242
+
+        import threading
+
+        stop = threading.Event()
+
+        def flood():
+            mallory = generate_key()
+            while not stop.is_set():
+                forged = Event.new(
+                    [b"forged"], [], [], ["", ""], victim.pub_key_bytes(), 0
+                )
+                forged.sign(mallory)
+                try:
+                    nodes[0].core.hg.set_wire_info(forged)
+                    rpc = RPC(EagerSyncRequest(mallory_id, [forged.to_wire()]))
+                    nodes[0]._process_rpc(rpc)
+                    rpc.wait(timeout=5)
+                except Exception:
+                    pass
+                time.sleep(0.01)
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        try:
+            bombard_and_wait(nodes, proxies, target_block=2, timeout=90.0)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+        check_gossip(nodes, 0, 2)
+        sentry = nodes[0].core.sentry
+        assert sentry.rejects.get("invalid_signature", 0) > 0
+        assert sentry.is_quarantined(mallory_id)
+        assert not sentry.is_quarantined(victim.id), (
+            "the spoofed victim must not be blamed for the forger's flood"
+        )
+        stats = nodes[0].get_stats()
+        assert int(stats["sentry_rejects_invalid_signature"]) > 0
+        assert int(stats["sentry_quarantines_total"]) >= 1
     finally:
         shutdown_all(nodes)

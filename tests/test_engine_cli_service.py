@@ -99,6 +99,15 @@ def test_engine_testnet_with_service(tmp_path):
         )
         assert len(validators) == 2
 
+        suspects = json.loads(
+            urllib.request.urlopen(f"{base}/suspects").read()
+        )
+        assert suspects["threshold"] > 0
+        assert suspects["proofs"] == []  # honest cluster: no evidence
+        assert isinstance(suspects["peers"], dict)
+        assert int(stats["sentry_rejects_total"]) == 0
+        assert int(stats["sync_limit_truncations"]) == 0
+
         timers = json.loads(
             urllib.request.urlopen(f"{base}/debug/timers").read()
         )

@@ -210,12 +210,21 @@ def test_fork():
         nd.sign_and_add(e, f"e{i}", index, ordered)
         h.insert_event(e, set_wire_info=True)
 
-    # 'a' forks node2's index-0 slot (different payload => different hash)
+    # 'a' forks node2's index-0 slot (different payload => different hash).
+    # The insert is refused like the reference — but as a typed ForkError
+    # carrying both signed branches (the equivocation evidence the sentry
+    # turns into a durable proof).
+    from babble_tpu.hashgraph import ForkError
+
     event_a = Event.new([b"yo"], [], [], ["", ""], nodes[2].pub_bytes, 0)
     nodes[2].sign_and_add(event_a, "a", index, ordered)
-    with pytest.raises(SelfParentError) as ei:
+    with pytest.raises(ForkError) as ei:
         h.insert_event(event_a, set_wire_info=True)
-    assert ei.value.normal
+    assert ei.value.creator == event_a.creator()
+    assert ei.value.index == 0
+    assert ei.value.existing is not None
+    assert ei.value.existing.hex() != event_a.hex()
+    assert ei.value.incoming is event_a
 
     e01 = Event.new([], [], [], [index["e0"], index["a"]], nodes[0].pub_bytes, 1)
     nodes[0].sign_and_add(e01, "e01", index, ordered)
