@@ -28,6 +28,7 @@ from ..version import __version__ as VERSION
 _RUN_FLAGS = {
     "datadir": ("data_dir", str),
     "log": ("log_level", str),
+    "log_json": ("log_json", bool),
     "listen": ("bind_addr", str),
     "advertise": ("advertise_addr", str),
     "service_listen": ("service_addr", str),
@@ -116,8 +117,12 @@ def cmd_run(args: argparse.Namespace) -> int:
     """Assemble and run the engine with a socket app proxy, or the dummy
     in-memory app with --inmem-dummy (run.go:29-60)."""
     from ..engine import Babble
+    from ..obs import log as obs_log
 
     conf = _build_config(args)
+    # One logging entry point for the whole process (obs/log.py):
+    # level/JSON toggle from config+flags, node correlation stamped.
+    obs_log.configure_from(conf)
     proxy = None
     if not args.inmem_dummy:
         from ..proxy.socket_proxy import SocketAppProxy
@@ -140,7 +145,9 @@ def cmd_signal(args: argparse.Namespace) -> int:
     import time as _time
 
     from ..net.signal import SignalServer
+    from ..obs import log as obs_log
 
+    obs_log.configure()
     if bool(args.cert) != bool(args.key):
         print("--cert and --key must be given together", file=sys.stderr)
         return 2
@@ -244,6 +251,11 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run a node")
     run.add_argument("--datadir", default=None)
     run.add_argument("--log", default=None)
+    run.add_argument(
+        "--log-json", dest="log_json", action="store_true",
+        help="structured JSON log lines (one object per line, node "
+        "correlation fields included)",
+    )
     run.add_argument("--listen", default=None, help="bind host:port")
     run.add_argument("--advertise", default=None)
     run.add_argument("--service-listen", dest="service_listen", default=None)

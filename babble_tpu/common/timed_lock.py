@@ -22,12 +22,16 @@ class TimedLock:
     """Drop-in ``threading.Lock`` replacement that records total time
     spent *waiting* to acquire (contention, not hold time)."""
 
-    __slots__ = ("_lock", "wait_s_total", "acquisitions")
+    __slots__ = ("_lock", "wait_s_total", "acquisitions", "observer")
 
-    def __init__(self) -> None:
+    def __init__(self, observer=None) -> None:
         self._lock = threading.Lock()
         self.wait_s_total: float = 0.0
         self.acquisitions: int = 0
+        # Optional per-contended-acquire wait observer (seconds) — the
+        # node wires the core_lock_wait_seconds histogram here; only
+        # contended acquires are observed (the fast path stays clockless).
+        self.observer = observer
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         # Fast path: an uncontended acquire skips the two clock reads —
@@ -39,7 +43,10 @@ class TimedLock:
             return False
         t0 = time.perf_counter()
         ok = self._lock.acquire(True, timeout)
-        self.wait_s_total += time.perf_counter() - t0
+        waited = time.perf_counter() - t0
+        self.wait_s_total += waited
+        if self.observer is not None:
+            self.observer(waited)
         if ok:
             self.acquisitions += 1
         return ok

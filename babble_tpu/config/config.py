@@ -36,6 +36,9 @@ class Config:
 
     data_dir: str = field(default_factory=default_data_dir)
     log_level: str = "info"
+    # Structured JSON log lines (obs/log.py): one object per line with
+    # ts/level/logger/msg + node correlation fields. Plain text when off.
+    log_json: bool = False
 
     bind_addr: str = "127.0.0.1:1337"
     advertise_addr: str = ""
@@ -143,6 +146,13 @@ class Config:
         return os.path.join(self.data_dir, DEFAULT_GENESIS_PEERS_FILE)
 
     def logger(self, name: str = "babble_tpu") -> logging.Logger:
+        """Per-component logger. Handlers/formatting are centralized in
+        obs/log.py (``obs.log.configure_from(conf)`` — the CLI entry
+        points call it); this only scopes the name and level."""
+        if not name.startswith("babble_tpu"):
+            # scope every component under the framework root so the one
+            # obs/log handler (and level) covers them all
+            name = f"babble_tpu.{name}"
         logger = logging.getLogger(f"{name}.{self.moniker or 'node'}")
         logger.setLevel(getattr(logging, self.log_level.upper(), logging.INFO))
         return logger

@@ -272,9 +272,20 @@ class TensorConsensus:
             "build": 0.0, "delta_scan": 0.0, "pack": 0.0,
             "dispatch": 0.0, "readback": 0.0, "kernel": 0.0, "apply": 0.0,
         }
+        # Optional per-sample stage observer (obs.telemetry wires the
+        # accel_stage_seconds{stage=...} histogram here); stage_s keeps
+        # the legacy rolling totals either way.
+        self.stage_observer = None
         self._inflight: Optional[_Inflight] = None
         self._compiling = set()
         self._lock = threading.Lock()
+
+    def _stage(self, stage: str, seconds: float) -> None:
+        """One stage sample: legacy rolling total + histogram observer."""
+        self.stage_s[stage] = self.stage_s.get(stage, 0.0) + seconds
+        obs = self.stage_observer
+        if obs is not None:
+            obs(stage, seconds)
 
     # -- gates --------------------------------------------------------------
 
@@ -549,7 +560,7 @@ class TensorConsensus:
         if not self.resident:
             t0 = time.perf_counter()
             win = voting.build_voting_window(hg)
-            self.stage_s["build"] += time.perf_counter() - t0
+            self._stage("build", time.perf_counter() - t0)
             return win, None
         timers: dict = {}
         try:
@@ -558,7 +569,7 @@ class TensorConsensus:
             )
         finally:
             for k, v in timers.items():
-                self.stage_s[k] = self.stage_s.get(k, 0.0) + v
+                self._stage(k, v)
         if snap is None:
             return None, None
         self.rows_delta_total += snap.rows_delta
@@ -699,7 +710,7 @@ class TensorConsensus:
         try:
             t_d = time.perf_counter()
             out = self._dispatch_snap(win, snap)
-            self.stage_s["dispatch"] += time.perf_counter() - t_d
+            self._stage("dispatch", time.perf_counter() - t_d)
 
             def reader() -> None:
                 try:
@@ -760,9 +771,9 @@ class TensorConsensus:
             state.note_applied(fame_applied, received)
         t_apply = time.perf_counter() - t0
         kernel_s = inf.t_done - inf.t_launch  # dispatch+kernel+readback
-        self.stage_s["apply"] += t_apply
-        self.stage_s["kernel"] += kernel_s
-        self.stage_s["readback"] += inf.readback_s
+        self._stage("apply", t_apply)
+        self._stage("kernel", kernel_s)
+        self._stage("readback", inf.readback_s)
         self.breaker.record_success()
         self.sweeps += 1
         self.last_window_events = len(inf.win.hashes)
@@ -801,7 +812,7 @@ class TensorConsensus:
                     self.contended += 1
                     self.breaker.cancel()
                     return False
-                self.stage_s["dispatch"] += time.perf_counter() - t1
+                self._stage("dispatch", time.perf_counter() - t1)
                 t_r = time.perf_counter()
                 if not ticket.done.wait(self.readback_timeout_s):
                     raise TimeoutError(
@@ -810,20 +821,20 @@ class TensorConsensus:
                 if ticket.error is not None:
                     raise ticket.error
                 fame, rr = ticket.result
-                self.stage_s["readback"] += time.perf_counter() - t_r
+                self._stage("readback", time.perf_counter() - t_r)
             else:
                 out = self._dispatch_snap(win, snap)
                 t_r = time.perf_counter()
-                self.stage_s["dispatch"] += t_r - t1
+                self._stage("dispatch", t_r - t1)
                 fame, rr = voting.read_sweep(out, win)
-                self.stage_s["readback"] += time.perf_counter() - t_r
+                self._stage("readback", time.perf_counter() - t_r)
             t2 = time.perf_counter()
-            self.stage_s["kernel"] += t2 - t1
+            self._stage("kernel", t2 - t1)
             _decided, fame_applied = voting.apply_fame(hg, win, fame)
             received = voting.apply_round_received(hg, win, rr)
             if snap is not None and self.window_state is not None:
                 self.window_state.note_applied(fame_applied, received)
-            self.stage_s["apply"] += time.perf_counter() - t2
+            self._stage("apply", time.perf_counter() - t2)
         except Exception as err:
             if _is_stale_window(err):
                 self.stale_drops += 1

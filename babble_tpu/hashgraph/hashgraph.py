@@ -48,6 +48,7 @@ from babble_tpu.hashgraph.event import (
 from babble_tpu.hashgraph.frame import Frame, Root
 from babble_tpu.hashgraph.round_info import RoundInfo
 from babble_tpu.hashgraph.store import Store
+from babble_tpu.obs.trace import staged
 from babble_tpu.peers.peer_set import PeerSet
 
 logger = logging.getLogger("babble_tpu.hashgraph")
@@ -117,6 +118,11 @@ class Hashgraph:
         # insert; inserts between sweeps are counted in _accel_pending.
         self.accel = None
         self._accel_pending = 0
+        # Pipeline-stage observer (obs.telemetry): fn(stage, seconds)
+        # feeding the sync_stage_seconds histogram + the active sync
+        # trace. None (bare hashgraphs, BABBLE_OBS=0) keeps the staged
+        # methods clockless — the decorator checks this attribute.
+        self.stage_observer = None
         # Delta channels for the accelerator's incremental WindowState
         # (ops/window_state.py): the insert path records the two mutations
         # a window snapshot cannot otherwise discover in O(ΔE) — witnesses
@@ -512,6 +518,7 @@ class Hashgraph:
         self.decide_round_received()
         self.process_decided_rounds()
 
+    @staged("insert")
     def insert_event(self, event: Event, set_wire_info: bool = False) -> None:
         """Verify signature, check parents, prevent forks, maintain
         coordinates, queue for consensus (reference: hashgraph.go:672-750)."""
@@ -584,6 +591,7 @@ class Hashgraph:
     # Consensus pipeline
     # =========================================================================
 
+    @staged("divide_rounds")
     def divide_rounds(self) -> None:
         """Assign round + Lamport timestamp to undetermined events, flag
         witnesses, queue pending rounds (reference: hashgraph.go:807-872).
@@ -650,6 +658,7 @@ class Hashgraph:
         if update_event:
             self.store.set_event(ev)
 
+    @staged("decide_fame")
     def decide_fame(self) -> None:
         """Virtual voting with coin rounds every COIN_ROUND_FREQ rounds
         (reference: hashgraph.go:875-998).
@@ -744,6 +753,7 @@ class Hashgraph:
 
         self.pending_rounds.update(decided_rounds)
 
+    @staged("round_received")
     def decide_round_received(self) -> None:
         """An event is received at the first decided round whose famous
         witnesses ALL see it (reference: hashgraph.go:1002-1095, quoting the
@@ -825,6 +835,7 @@ class Hashgraph:
             if not received:
                 new_undetermined.append(x)
 
+    @staged("commit")
     def process_decided_rounds(self) -> None:
         """Map decided rounds onto Frames and Blocks, committing via the
         callback (reference: hashgraph.go:1100-1181)."""

@@ -83,7 +83,19 @@ class Mempool:
         self.overflow = overflow
         self.event_max_txs = event_max_txs
         self.event_max_bytes = event_max_bytes
+        self._clock = clock
         self._lock = threading.Lock()
+        # Commit-latency telemetry (attach_telemetry): per-hash admit and
+        # drain timestamps feed commit_latency_seconds and the
+        # tx_stage_seconds{mempool_wait,consensus} histograms. The dicts
+        # are bounded by construction — keys are a subset of
+        # pending ∪ in-flight, both capped — and stay EMPTY (zero
+        # overhead) until telemetry is attached.
+        self._lat_commit = None
+        self._lat_wait = None
+        self._lat_consensus = None
+        self._admit_ts: Dict[bytes, float] = {}
+        self._drain_ts: Dict[bytes, float] = {}
         self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._pending_bytes = 0
         # Drained-but-uncommitted hashes (bytes already live in the
@@ -128,6 +140,15 @@ class Mempool:
             burst=conf.mempool_burst,
         )
 
+    def attach_telemetry(self, commit_latency, tx_wait, tx_consensus) -> None:
+        """Arm the latency histograms (obs.telemetry wiring): from here
+        on accepted transactions are timestamped at admit and drain, and
+        ``mark_committed`` observes admit→commit into ``commit_latency``
+        plus the mempool_wait / consensus stage splits."""
+        self._lat_commit = commit_latency
+        self._lat_wait = tx_wait
+        self._lat_consensus = tx_consensus
+
     # -- admission ----------------------------------------------------------
 
     def submit(self, tx: bytes) -> str:
@@ -159,12 +180,17 @@ class Mempool:
                 if self.overflow != POLICY_EVICT_OLDEST or not self._pending:
                     self.rejected_full += 1
                     return FULL
-                _, old = self._pending.popitem(last=False)
+                old_h, old = self._pending.popitem(last=False)
                 self._pending_bytes -= len(old)
+                self._admit_ts.pop(old_h, None)
+                # a requeued tx back in pending can carry a drain stamp
+                self._drain_ts.pop(old_h, None)
                 self.evictions += 1
             self._pending[h] = tx
             self._pending_bytes += size
             self.accepted += 1
+            if self._lat_commit is not None:
+                self._admit_ts[h] = self._clock()
             return ACCEPTED
 
     def submit_many(self, txs) -> List[str]:
@@ -179,6 +205,7 @@ class Mempool:
         out: List[bytes] = []
         nbytes = 0
         with self._lock:
+            now = self._clock() if self._lat_commit is not None else 0.0
             while self._pending and len(out) < self.event_max_txs:
                 h, tx = next(iter(self._pending.items()))
                 if out and nbytes + len(tx) > self.event_max_bytes:
@@ -188,8 +215,18 @@ class Mempool:
                 out.append(tx)
                 nbytes += len(tx)
                 self._inflight[h] = len(tx)
+                ts = self._admit_ts.get(h)
+                if ts is not None and h not in self._drain_ts:
+                    # first drain only: a requeued tx keeps its original
+                    # drain stamp, so mempool_wait gets exactly ONE
+                    # sample per tx (admit → first drain) and its count
+                    # matches commit_latency_seconds
+                    self._drain_ts[h] = now
+                    self._lat_wait.observe(now - ts)
             while len(self._inflight) > self._inflight_cap:
-                self._inflight.popitem(last=False)
+                aged_h, _ = self._inflight.popitem(last=False)
+                self._admit_ts.pop(aged_h, None)
+                self._drain_ts.pop(aged_h, None)
                 self.inflight_aged += 1
         return out
 
@@ -203,7 +240,14 @@ class Mempool:
             for tx in reversed(txs):
                 h = sha256(tx)
                 self._inflight.pop(h, None)
+                # back to pending: BOTH timestamps survive — the client
+                # has been waiting the whole time, and keeping the
+                # first-drain stamp makes the consensus stage cover
+                # first drain → commit (requeue interludes included)
+                # without re-observing mempool_wait on the next drain
                 if self._committed is not None and self._committed.peek(h)[1]:
+                    self._admit_ts.pop(h, None)
+                    self._drain_ts.pop(h, None)
                     continue
                 if h in self._pending:
                     continue
@@ -222,6 +266,7 @@ class Mempool:
         committed transaction (submitted to several nodes, committed via
         another's event) are dropped before they can double-commit."""
         with self._lock:
+            now = self._clock() if self._lat_commit is not None else 0.0
             for tx in txs:
                 h = sha256(bytes(tx))
                 self.committed_total += 1
@@ -232,6 +277,15 @@ class Mempool:
                 if old is not None:
                     self._pending_bytes -= len(old)
                     self.commit_drops += 1
+                ts = self._admit_ts.pop(h, None)
+                dts = self._drain_ts.pop(h, None)
+                if ts is not None:
+                    # end-to-end north-star latency: admit → block commit
+                    # (only for txs THIS node admitted; gossip-received
+                    # txs have no local admit time)
+                    self._lat_commit.observe(now - ts)
+                    if dts is not None:
+                        self._lat_consensus.observe(now - dts)
 
     # -- views --------------------------------------------------------------
 

@@ -122,8 +122,13 @@ class MobileNode:
         self._proxy.submit_tx(bytes(tx))
 
     def get_stats(self) -> str:
-        """JSON stats string (reference: mobile/node.go:122-128)."""
-        return json.dumps(self._engine.node.get_stats())
+        """JSON stats string (reference: mobile/node.go:122-128).
+
+        Serialized from the TYPED snapshot — numbers cross the bridge
+        as JSON numbers, not strings (the stringly map is the
+        reference-parity `Node.get_stats` view; embedders should not
+        have to re-parse it)."""
+        return json.dumps(self._engine.node.get_stats_snapshot())
 
     def get_id(self) -> int:
         return self._engine.node.get_id()

@@ -153,6 +153,17 @@ class Core:
             self.accelerator_mesh = accelerator_mesh
             self.hg.accel = TensorConsensus()
 
+        # Telemetry (docs/observability.md): the per-node registry wiring
+        # every subsystem's counters into instruments, created at the
+        # core so standalone cores (benches, tests) measure identically
+        # to full nodes. _stage_obs is None under BABBLE_OBS=0 — the
+        # timing sites below null-check it and skip even the clock reads.
+        from ..obs.telemetry import NodeTelemetry
+
+        self.obs = NodeTelemetry(self)
+        self._stage_obs = self.obs.stage_observer
+        self.hg.stage_observer = self._stage_obs
+
     # -- head/seq -----------------------------------------------------------
 
     def set_head_and_seq(self) -> None:
@@ -237,6 +248,8 @@ class Core:
         decoded so far. Returns (decoded, next_pos); a decode stall cuts
         the run at next_pos. Shared by the lock-free prepare stage and
         sync's under-lock tail so their semantics can never diverge."""
+        obs = self._stage_obs
+        t0 = time.perf_counter() if obs is not None else 0.0
         overlay: Dict[tuple, str] = {}
         decoded: List[Event] = []
         j = start
@@ -254,6 +267,8 @@ class Core:
             overlay.setdefault((ev.creator(), ev.index()), ev.hex())
             decoded.append(ev)
             j += 1
+        if obs is not None:
+            obs("decode", time.perf_counter() - t0)
         return decoded, j
 
     def _batch_prevalidate(self, decoded: List[Event]) -> None:
@@ -263,6 +278,8 @@ class Core:
         can never reject a valid event and a genuinely bad event is
         identified exactly (its verdict stays cached for insert to
         reject)."""
+        obs = self._stage_obs
+        t_verify = time.perf_counter() if obs is not None else 0.0
         use_device_verify = self.accelerated_verify
         if use_device_verify:
             # Measured on the target: the device ladder kernel costs
@@ -292,6 +309,8 @@ class Core:
 
             if not prevalidate_events_host(decoded):
                 # Native library unavailable: scalar verify at insert.
+                if obs is not None:
+                    obs("batch_verify", time.perf_counter() - t_verify)
                 return
         self.ingest_batch_verifies += 1
         if len(decoded) > self.ingest_batch_size_max:
@@ -301,6 +320,8 @@ class Core:
                 ev.clear_prevalidation()
                 ev.prevalidate(ev.verify())
                 self.ingest_fallback_singles += 1
+        if obs is not None:
+            obs("batch_verify", time.perf_counter() - t_verify)
 
     def sync(
         self,
@@ -432,6 +453,8 @@ class Core:
             )
             return
 
+        obs = self._stage_obs
+        t_event = time.perf_counter() if obs is not None else 0.0
         sigs = list(self.self_block_signatures.values())
         n_itxs = len(self.internal_transaction_pool)
 
@@ -440,6 +463,8 @@ class Core:
         # gossip payloads stay bounded under sustained overload; leftovers
         # keep busy() true and ride the next event (FIFO fairness).
         txs = self.mempool.drain()
+        if obs is not None:
+            obs("mempool_drain", time.perf_counter() - t_event)
 
         new_head = Event.new(
             txs,
@@ -464,6 +489,10 @@ class Core:
         self.internal_transaction_pool = self.internal_transaction_pool[n_itxs:]
         for s in sigs:
             self.self_block_signatures.pop(s.key(), None)
+        if obs is not None:
+            # whole self-event packaging incl. its insert+DivideRounds
+            # (the nested insert/divide_rounds stages record too)
+            obs("self_event", time.perf_counter() - t_event)
 
     def sign_and_insert_self_event(self, event: Event) -> None:
         """reference: core.go:337-343."""
@@ -563,7 +592,15 @@ class Core:
     def commit(self, block: Block) -> None:
         """The hashgraph's commit callback: push the block to the app, sign
         it, and process membership receipts (reference: core.go:485-536)."""
-        commit_response = self.proxy_commit_callback(block)
+        obs = self._stage_obs
+        if obs is None:
+            commit_response = self.proxy_commit_callback(block)
+        else:
+            t0 = time.perf_counter()
+            try:
+                commit_response = self.proxy_commit_callback(block)
+            finally:
+                obs("proxy_deliver", time.perf_counter() - t0)
 
         # Feed the committed-hash LRU atomically with the commit (under
         # the mempool's own lock): from here on a client retry of any of

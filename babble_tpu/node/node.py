@@ -84,9 +84,14 @@ class Node(StateManager):
         # Equivocation proofs persist through the store's evidence table
         # (and load back on restart) when the store supports it.
         self.core.sentry.attach_store(store)
+        # Telemetry: the core created its registry (docs/observability.md);
+        # bind the node-level instruments (RPC counters, queue depth) and
+        # take the sync-stage observer for the gossip legs below.
+        self.telemetry = self.core.obs
         # Instrumented core lock: get_stats surfaces total acquisition
-        # wait (lock_wait_ms_total) so lock-shrinking work stays measured.
-        self.core_lock = TimedLock()
+        # wait (lock_wait_ms_total) so lock-shrinking work stays measured;
+        # contended waits also feed the core_lock_wait_seconds histogram.
+        self.core_lock = TimedLock(observer=self.telemetry.lock_wait_observer)
         self.trans = trans
         self.proxy = proxy
         self.submit_q = proxy.submit_queue()
@@ -117,6 +122,10 @@ class Node(StateManager):
         # the pull response) — a hostile peer must not dictate how much
         # we ingest per request.
         self.sync_limit_truncations = 0
+        # Outbound gossip rounds lost to TransportErrors — the network-
+        # fault counter the chaos soaks assert on (rpc_errors_* counts
+        # handler crashes, this counts the wire).
+        self.gossip_transport_errors = 0
         # Joining-state backoff: consecutive join failures grow the retry
         # sleep exponentially (capped by conf.join_backoff_cap) so a node
         # stuck outside a partitioned cluster doesn't hammer dead peers.
@@ -130,6 +139,7 @@ class Node(StateManager):
         # threads onto core_lock under the GIL (the Go reference relies on
         # cheap goroutines; here 2 in flight keeps the pipeline full).
         self._gossip_slots = threading.Semaphore(2)
+        self.telemetry.bind_node(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -312,18 +322,23 @@ class Node(StateManager):
     def get_all_validator_sets(self) -> Dict[int, List[Peer]]:
         return self.core.hg.store.get_all_peer_sets()
 
-    def get_stats(self) -> Dict[str, str]:
-        """reference: node.go:277-294."""
-        stats = {
-            "last_consensus_round": str(self.get_last_consensus_round_index()),
-            "last_block_index": str(self.get_last_block_index()),
-            "consensus_events": str(self.core.get_consensus_events_count()),
-            "undetermined_events": str(len(self.core.get_undetermined_events())),
-            "transactions": str(self.core.get_consensus_transactions_count()),
-            "transaction_pool": str(self.core.mempool.pending_count),
-            "num_peers": str(len(self.core.peer_selector.get_peers())),
-            "last_peer_change": str(self.core.last_peer_change_round),
-            "id": str(self.get_id()),
+    def get_stats_snapshot(self) -> Dict[str, object]:
+        """One TYPED stats snapshot (numbers stay numbers) — the single
+        source for ``get_stats`` (string view, the reference contract),
+        the mobile JSON surface, and the /stats endpoint. The same
+        underlying counters back the registry instruments served at
+        /metrics (docs/observability.md; compat contract: docs/parity.md
+        #27)."""
+        stats: Dict[str, object] = {
+            "last_consensus_round": self.get_last_consensus_round_index(),
+            "last_block_index": self.get_last_block_index(),
+            "consensus_events": self.core.get_consensus_events_count(),
+            "undetermined_events": len(self.core.get_undetermined_events()),
+            "transactions": self.core.get_consensus_transactions_count(),
+            "transaction_pool": self.core.mempool.pending_count,
+            "num_peers": len(self.core.peer_selector.get_peers()),
+            "last_peer_change": self.core.last_peer_change_round,
+            "id": self.get_id(),
             "state": str(self.get_state()),
             "moniker": self.core.validator.moniker,
         }
@@ -337,49 +352,57 @@ class Node(StateManager):
 
         stats.update(
             {
-                "ingest_syncs": str(self.core.ingest_syncs),
-                "ingest_batch_verifies": str(self.core.ingest_batch_verifies),
-                "ingest_batch_size_max": str(self.core.ingest_batch_size_max),
-                "ingest_fallback_singles": str(
-                    self.core.ingest_fallback_singles
+                "ingest_syncs": self.core.ingest_syncs,
+                "ingest_batch_verifies": self.core.ingest_batch_verifies,
+                "ingest_batch_size_max": self.core.ingest_batch_size_max,
+                "ingest_fallback_singles": self.core.ingest_fallback_singles,
+                "lock_wait_ms_total": round(
+                    self.core_lock.wait_ms_total(), 1
                 ),
-                "lock_wait_ms_total": str(
-                    round(self.core_lock.wait_ms_total(), 1)
-                ),
-                "lock_acquisitions": str(self.core_lock.acquisitions),
-                "wire_cache_hits": str(WIRE_CACHE.hits),
-                "wire_cache_misses": str(WIRE_CACHE.misses),
-                "norm_cache_hits": str(NORM_CACHE.hits),
-                "norm_cache_misses": str(NORM_CACHE.misses),
+                "lock_acquisitions": self.core_lock.acquisitions,
+                "wire_cache_hits": WIRE_CACHE.hits,
+                "wire_cache_misses": WIRE_CACHE.misses,
+                "norm_cache_hits": NORM_CACHE.hits,
+                "norm_cache_misses": NORM_CACHE.misses,
             }
         )
         # Mempool surface (docs/mempool.md): admission verdict counters,
         # pending gauges, eviction/requeue totals.
         stats.update(
             {
-                f"mempool_{k}": str(v)
+                f"mempool_{k}": v
                 for k, v in self.core.mempool.stats().items()
             }
         )
         # Robustness surface: handler crash counters per RPC type, the
-        # peer selector's health/backoff view of the network, and the
-        # sentry's misbehavior/quarantine ledger.
+        # gossip-side transport failure counter, the peer selector's
+        # health/backoff view of the network, and the sentry's
+        # misbehavior/quarantine ledger.
         stats.update(
-            {f"rpc_errors_{k}": str(v) for k, v in self.rpc_errors.items()}
+            {f"rpc_errors_{k}": v for k, v in self.rpc_errors.items()}
         )
-        stats.update(
-            {k: str(v) for k, v in self.core.peer_selector.stats().items()}
-        )
-        stats["sync_limit_truncations"] = str(self.sync_limit_truncations)
-        stats.update(
-            {k: str(v) for k, v in self.core.sentry.stats().items()}
-        )
+        stats["gossip_transport_errors"] = self.gossip_transport_errors
+        stats.update(self.core.peer_selector.stats())
+        stats["sync_limit_truncations"] = self.sync_limit_truncations
+        stats.update(self.core.sentry.stats())
+        # Commit-latency percentiles from the registry histogram — the
+        # north-star p50/p90/p99 (ms), None until the first local commit.
+        clat = self.telemetry.commit_latency_ms()
+        stats["commit_latency_samples"] = clat["count"]
+        stats["commit_latency_p50_ms"] = clat["p50_ms"]
+        stats["commit_latency_p90_ms"] = clat["p90_ms"]
+        stats["commit_latency_p99_ms"] = clat["p99_ms"]
         accel = self.core.hg.accel
         if accel is not None:
-            stats.update({k: str(v) for k, v in accel.stats().items()})
+            stats.update(accel.stats())
         else:
             stats["consensus_engine"] = "oracle"
         return stats
+
+    def get_stats(self) -> Dict[str, str]:
+        """reference: node.go:277-294 — the reference's stringly map,
+        derived at the edge from the typed snapshot."""
+        return {k: str(v) for k, v in self.get_stats_snapshot().items()}
 
     # -- background ---------------------------------------------------------
 
@@ -487,9 +510,15 @@ class Node(StateManager):
                 self.core.process_sig_pool()
 
     def _gossip(self, peer: Peer) -> None:
-        """Pull-push gossip round (reference: node.go:466-501)."""
+        """Pull-push gossip round (reference: node.go:466-501).
+
+        The whole round runs under one sync trace: stages timed here and
+        deep in the core/hashgraph pipeline attach to it through the
+        tracer's thread-local, and the finished span lands in the
+        /telemetry recent-syncs ring."""
         connected = False
         transport_failure = False
+        trace = self.telemetry.start_sync_trace(peer.id)
         try:
             other_known = self._pull(peer)
             self._push(peer, other_known)
@@ -497,6 +526,7 @@ class Node(StateManager):
             self._log_stats()
         except TransportError as err:
             transport_failure = True
+            self.gossip_transport_errors += 1
             self.logger.debug("gossip transport error: %s", err)
         except Exception as err:
             # Classified ingest rejections (typed hashgraph errors) feed
@@ -510,6 +540,7 @@ class Node(StateManager):
             else:
                 self.logger.warning("gossip error: %s", err)
         finally:
+            trace.finish()
             # only NETWORK failures decay the peer's health/backoff; a
             # local error (the generic branch) isn't the peer's fault
             self.core.peer_selector.update_last(
@@ -522,7 +553,9 @@ class Node(StateManager):
             known = self.core.known_events()
         t0 = time.monotonic()
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
-        self.timers.record("request_sync", time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.timers.record("request_sync", dt)
+        self.telemetry.observe_stage("request_sync", dt)
         if len(resp.events) > self.conf.sync_limit:
             # We asked for at most sync_limit events; a bigger response
             # means the peer ignored the negotiated cap.
@@ -544,7 +577,9 @@ class Node(StateManager):
         t0 = time.monotonic()
         with self.core_lock:
             diff = self.core.event_diff(known_events)
-        self.timers.record("diff", time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.timers.record("diff", dt)
+        self.telemetry.observe_stage("diff", dt)
         if not diff:
             return
         if len(diff) > self.conf.sync_limit:
@@ -552,7 +587,9 @@ class Node(StateManager):
         wire = self.core.to_wire(diff)
         t0 = time.monotonic()
         self._request_eager_sync(peer.net_addr, wire)
-        self.timers.record("eager_sync", time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.timers.record("eager_sync", dt)
+        self.telemetry.observe_stage("eager_sync", dt)
 
     def _sync(
         self,
@@ -575,7 +612,9 @@ class Node(StateManager):
             # behind the re-raise.
             t0 = time.monotonic()
             self.core.process_sig_pool()
-            self.timers.record("process_sig_pool", time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self.timers.record("process_sig_pool", dt)
+            self.telemetry.observe_stage("process_sig_pool", dt)
 
     # -- catching up --------------------------------------------------------
 
@@ -886,6 +925,16 @@ class Node(StateManager):
     def _admit_transaction(self, tx: bytes) -> str:
         """Mempool admission; returns the verdict (proxy submit handler)."""
         return self.core.mempool.submit(tx)
+
+    def get_metrics_text(self) -> str:
+        """/metrics service payload: Prometheus text exposition of the
+        node registry + the process-global registry."""
+        return self.telemetry.render_metrics()
+
+    def get_telemetry(self) -> Dict[str, object]:
+        """/telemetry service payload: every instrument as JSON
+        (histograms with computed p50/p90/p99) + recent sync traces."""
+        return self.telemetry.telemetry_view()
 
     def get_mempool(self) -> Dict[str, object]:
         """/mempool service payload: knobs + live counters."""

@@ -1,0 +1,330 @@
+"""Instrument catalog — the single source of truth for every metric.
+
+``NodeTelemetry`` registers instruments BY NAME through this catalog
+(an unknown name raises, so an undocumented instrument cannot ship);
+``docs/observability.md`` carries the same set as a markdown table; and
+``python -m babble_tpu.obs.lint`` fails the build when the two drift in
+either direction. Scopes:
+
+- ``node``   — registered for every node;
+- ``accel``  — registered only when the node runs with ``--accelerator``;
+- ``global`` — process-wide (shared by co-located nodes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+
+class Instrument(NamedTuple):
+    name: str
+    kind: str  # counter | gauge | histogram
+    labels: Tuple[str, ...]
+    scope: str  # node | accel | global
+    help: str
+
+
+_C, _G, _H = "counter", "gauge", "histogram"
+
+CATALOG: Tuple[Instrument, ...] = (
+    # -- end-to-end latency + pipeline stages -------------------------------
+    Instrument(
+        "commit_latency_seconds", _H, (), "node",
+        "End-to-end submit-to-commit latency for transactions admitted by "
+        "THIS node's mempool (admit timestamp to Core.commit).",
+    ),
+    Instrument(
+        "tx_stage_seconds", _H, ("stage",), "node",
+        "Transaction lifecycle split: mempool_wait (admit to drain into a "
+        "self-event) and consensus (drain to block commit).",
+    ),
+    Instrument(
+        "sync_stage_seconds", _H, ("stage",), "node",
+        "Per-stage wall time of the gossip/consensus pipeline: "
+        "request_sync, decode, batch_verify, insert, divide_rounds, "
+        "decide_fame, round_received, commit, proxy_deliver, "
+        "process_sig_pool, diff, eager_sync, mempool_drain, self_event.",
+    ),
+    Instrument(
+        "core_lock_wait_seconds", _H, (), "node",
+        "Time spent WAITING to acquire the core lock per contended "
+        "acquisition (uncontended acquires are not observed).",
+    ),
+    # -- core lock / ingest fast path ---------------------------------------
+    Instrument(
+        "core_lock_wait_seconds_total", _C, (), "node",
+        "Total core-lock acquisition wait (the legacy lock_wait_ms_total, "
+        "in seconds).",
+    ),
+    Instrument(
+        "core_lock_acquisitions_total", _C, (), "node",
+        "Core-lock acquisitions.",
+    ),
+    Instrument(
+        "ingest_syncs_total", _C, (), "node",
+        "Incoming syncs ingested (pull responses + eager pushes).",
+    ),
+    Instrument(
+        "ingest_batch_verifies_total", _C, (), "node",
+        "Native batch signature-verification calls (one per sync chunk on "
+        "the happy path).",
+    ),
+    Instrument(
+        "ingest_batch_size_max", _G, (), "node",
+        "Largest batch handed to the batch verifier so far.",
+    ),
+    Instrument(
+        "ingest_fallback_singles_total", _C, (), "node",
+        "Per-event scalar signature re-checks after a batch reported "
+        "failures (offender pinpointing).",
+    ),
+    # -- gossip / RPC surface ----------------------------------------------
+    Instrument(
+        "sync_requests_total", _C, (), "node",
+        "SyncRequest RPCs served.",
+    ),
+    Instrument(
+        "sync_errors_total", _C, (), "node",
+        "SyncRequest handler errors.",
+    ),
+    Instrument(
+        "rpc_errors_total", _C, ("type",), "node",
+        "Handler crashes per RPC type (sync, eager_sync, fast_forward, "
+        "join) — crashes, not remote faults.",
+    ),
+    Instrument(
+        "gossip_transport_errors_total", _C, (), "node",
+        "Outbound gossip rounds that failed with a TransportError "
+        "(network faults, not handler errors).",
+    ),
+    Instrument(
+        "sync_limit_truncations_total", _C, (), "node",
+        "Incoming batches truncated to our sync_limit (receiving-side "
+        "cap).",
+    ),
+    Instrument(
+        "submit_queue_depth", _G, (), "node",
+        "Transactions sitting in the proxy submit queue (sampled at "
+        "scrape).",
+    ),
+    # -- consensus progress -------------------------------------------------
+    Instrument(
+        "node_last_block_index", _G, (), "node",
+        "Index of the last committed block.",
+    ),
+    Instrument(
+        "node_last_consensus_round", _G, (), "node",
+        "Last round that reached consensus (-1 before the first).",
+    ),
+    Instrument(
+        "node_consensus_events", _G, (), "node",
+        "Events that reached consensus order.",
+    ),
+    Instrument(
+        "node_undetermined_events", _G, (), "node",
+        "Events whose round-received is still undecided.",
+    ),
+    Instrument(
+        "node_consensus_transactions_total", _C, (), "node",
+        "Transactions carried by consensus events so far.",
+    ),
+    Instrument(
+        "node_peers", _G, (), "node",
+        "Current peer-set size as seen by the selector.",
+    ),
+    # -- mempool ------------------------------------------------------------
+    Instrument(
+        "mempool_pending", _G, (), "node",
+        "Pending (admitted, not yet drained) transactions.",
+    ),
+    Instrument(
+        "mempool_pending_bytes", _G, (), "node",
+        "Bytes held by pending transactions.",
+    ),
+    Instrument(
+        "mempool_inflight", _G, (), "node",
+        "Drained-but-uncommitted transaction hashes tracked for dedup.",
+    ),
+    Instrument(
+        "mempool_submitted_total", _C, (), "node",
+        "Admission attempts.",
+    ),
+    Instrument(
+        "mempool_accepted_total", _C, (), "node",
+        "Admissions that returned `accepted`.",
+    ),
+    Instrument(
+        "mempool_rejected_total", _C, ("reason",), "node",
+        "Rejected admissions by verdict: full, duplicate, oversized, "
+        "throttled, already_committed.",
+    ),
+    Instrument(
+        "mempool_committed_total", _C, (), "node",
+        "Transactions marked committed through this node's commit path.",
+    ),
+    Instrument(
+        "mempool_evictions_total", _C, (), "node",
+        "Oldest-pending evictions under the evict-oldest overflow policy.",
+    ),
+    Instrument(
+        "mempool_requeued_total", _C, (), "node",
+        "Drained transactions put back after a failed self-event insert.",
+    ),
+    Instrument(
+        "mempool_commit_drops_total", _C, (), "node",
+        "Pending copies dropped because the same tx committed via another "
+        "node's event.",
+    ),
+    Instrument(
+        "mempool_inflight_aged_total", _C, (), "node",
+        "In-flight hashes aged out past the dedup cap.",
+    ),
+    # -- peer selector / gossip health -------------------------------------
+    Instrument(
+        "selector_unhealthy_peers", _G, (), "node",
+        "Peers with a nonzero consecutive-failure count.",
+    ),
+    Instrument(
+        "selector_backed_off_peers", _G, (), "node",
+        "Peers currently inside a backoff window.",
+    ),
+    Instrument(
+        "selector_backoff_skips_total", _C, (), "node",
+        "Peer picks skipped because the peer was backed off.",
+    ),
+    Instrument(
+        "selector_probe_picks_total", _C, (), "node",
+        "Deterministic probe picks of expired-backoff peers.",
+    ),
+    Instrument(
+        "selector_starvation_overrides_total", _C, (), "node",
+        "All-backed-off liveness overrides.",
+    ),
+    Instrument(
+        "selector_quarantine_skips_total", _C, (), "node",
+        "Peer picks skipped because the sentry quarantined the peer.",
+    ),
+    Instrument(
+        "selector_quarantine_overrides_total", _C, (), "node",
+        "All-quarantined liveness overrides.",
+    ),
+    # -- sentry -------------------------------------------------------------
+    Instrument(
+        "sentry_quarantined_peers", _G, (), "node",
+        "Peers currently quarantined.",
+    ),
+    Instrument(
+        "sentry_quarantines_total", _C, (), "node",
+        "Quarantines imposed.",
+    ),
+    Instrument(
+        "sentry_quarantine_deferrals_total", _C, (), "node",
+        "Quarantines deferred by the BFT framing-guard cap.",
+    ),
+    Instrument(
+        "sentry_readmissions_total", _C, (), "node",
+        "Quarantine expiries that re-admitted a peer.",
+    ),
+    Instrument(
+        "sentry_refused_rpcs_total", _C, (), "node",
+        "Inbound syncs refused from quarantined peers.",
+    ),
+    Instrument(
+        "sentry_proofs", _G, (), "node",
+        "Durable equivocation proofs on file.",
+    ),
+    Instrument(
+        "sentry_rejects_total", _C, ("cause",), "node",
+        "Classified ingest rejections by cause slug "
+        "(docs/robustness.md attack catalog).",
+    ),
+    # -- accelerator (scope: accel) ----------------------------------------
+    Instrument(
+        "accel_stage_seconds", _H, ("stage",), "accel",
+        "Per-stage device-sweep time: build, delta_scan, pack, dispatch, "
+        "kernel, readback, apply.",
+    ),
+    Instrument(
+        "accel_sweeps_total", _C, (), "accel",
+        "Voting sweeps executed on the device path.",
+    ),
+    Instrument(
+        "accel_fallbacks_total", _C, (), "accel",
+        "Sweeps that fell back to the host oracle.",
+    ),
+    Instrument(
+        "accel_compile_waits_total", _C, (), "accel",
+        "Sweeps that waited on an XLA compile.",
+    ),
+    Instrument(
+        "accel_stale_drops_total", _C, (), "accel",
+        "Sweep results dropped for arriving with a stale window "
+        "generation.",
+    ),
+    Instrument(
+        "accel_rebuilds_total", _C, (), "accel",
+        "Window-state rebuilds (incremental path fell back to a full "
+        "snapshot).",
+    ),
+    Instrument(
+        "accel_rows_delta_total", _C, (), "accel",
+        "Window rows uploaded as deltas.",
+    ),
+    Instrument(
+        "accel_rows_reused_total", _C, (), "accel",
+        "Window rows served from device-resident buffers.",
+    ),
+    Instrument(
+        "accel_breaker_state", _G, (), "accel",
+        "Circuit-breaker state: 0=closed, 1=half_open, 2=open.",
+    ),
+    Instrument(
+        "accel_breaker_opens_total", _C, (), "accel",
+        "closed-to-open breaker transitions.",
+    ),
+    # -- process-wide (scope: global) --------------------------------------
+    Instrument(
+        "wire_cache_hits_total", _C, (), "global",
+        "Wire-event serialization cache hits (process-wide).",
+    ),
+    Instrument(
+        "wire_cache_misses_total", _C, (), "global",
+        "Wire-event serialization cache misses (process-wide).",
+    ),
+    Instrument(
+        "norm_cache_hits_total", _C, (), "global",
+        "Canonical-JSON normalization cache hits (process-wide).",
+    ),
+    Instrument(
+        "norm_cache_misses_total", _C, (), "global",
+        "Canonical-JSON normalization cache misses (process-wide).",
+    ),
+)
+
+BY_NAME: Dict[str, Instrument] = {i.name: i for i in CATALOG}
+
+# Stage label values documented for the span tables (docs lint checks
+# the stage table too, so a new stage must be documented to ship).
+SYNC_STAGES = (
+    "request_sync", "decode", "batch_verify", "insert", "divide_rounds",
+    "decide_fame", "round_received", "commit", "proxy_deliver",
+    "process_sig_pool", "diff", "eager_sync", "mempool_drain",
+    "self_event",
+)
+TX_STAGES = ("mempool_wait", "consensus")
+ACCEL_STAGES = (
+    "build", "delta_scan", "pack", "dispatch", "kernel", "readback",
+    "apply",
+)
+
+
+def spec(name: str) -> Instrument:
+    """Catalog lookup used at registration time: an instrument that is
+    not documented here cannot be registered at all."""
+    try:
+        return BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"instrument {name!r} is not in the obs catalog — add it to "
+            "babble_tpu/obs/catalog.py AND docs/observability.md"
+        ) from None

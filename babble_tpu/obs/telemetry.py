@@ -1,0 +1,344 @@
+"""NodeTelemetry: the per-node metrics registry and its wiring.
+
+One instance is created by ``Core.__init__`` (so cores used standalone
+— benches, tests — carry the same instruments as full nodes) and
+extended by ``Node`` via ``bind_node``. It owns:
+
+- the **hot instruments**: ``commit_latency_seconds``,
+  ``sync_stage_seconds{stage}``, ``tx_stage_seconds{stage}``,
+  ``core_lock_wait_seconds`` (observed from the mempool's commit feed,
+  the pipeline stage observers, and the TimedLock hook);
+- **function-backed instruments** over every subsystem's existing
+  counters (core ingest_*, mempool, sentry, selector, accel, node RPC
+  counters) — zero hot-path cost, evaluated at scrape;
+- the **tracer** (span ring served at ``/telemetry``);
+- the **legacy snapshot**: ``stats_snapshot()`` yields the typed
+  ``get_stats`` payload (numbers stay numbers; ``Node.get_stats``
+  stringifies at the edge — the compatibility contract recorded in
+  docs/parity.md).
+
+Every instrument name must exist in ``obs.catalog`` (registration
+raises otherwise), which is what keeps the docs table honest.
+
+With ``BABBLE_OBS=0`` the hot instruments are no-ops, the stage
+observers are ``None`` (callers skip even the clock reads), and traces
+are never opened — only the scrape-time function instruments remain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from . import catalog
+from .metrics import (
+    GLOBAL,
+    LATENCY_BUCKETS,
+    STAGE_BUCKETS,
+    Registry,
+    enabled as obs_enabled,
+    wire_global,
+)
+from .trace import NULL_TRACE, Tracer
+
+
+class NodeTelemetry:
+    def __init__(self, core, enabled: Optional[bool] = None):
+        self.enabled = obs_enabled() if enabled is None else enabled
+        self.registry = Registry(enabled=self.enabled)
+        wire_global()
+        self._core = core
+        self._node = None
+
+        # -- hot instruments ------------------------------------------------
+        self.commit_latency = self._histogram(
+            "commit_latency_seconds", LATENCY_BUCKETS
+        )
+        self._sync_stage = self._histogram(
+            "sync_stage_seconds", STAGE_BUCKETS
+        )
+        self._tx_stage = self._histogram(
+            "tx_stage_seconds", LATENCY_BUCKETS
+        )
+        self.lock_wait = self._histogram(
+            "core_lock_wait_seconds", STAGE_BUCKETS
+        )
+        # Pre-resolved per-stage children so the hot path pays one dict
+        # get, not a labels() call.
+        self._stage_children: Dict[str, object] = {}
+        self.tracer = Tracer(stage_sink=self._observe_stage_hist)
+
+        # The observer the pipeline code null-checks: None when disabled
+        # so instrumented code skips even its perf_counter reads.
+        self.stage_observer = self.tracer.observe if self.enabled else None
+        self.lock_wait_observer = (
+            self.lock_wait.observe if self.enabled else None
+        )
+
+        self._wire_core(core)
+        self._wire_mempool(core.mempool)
+        self._wire_sentry(core.sentry)
+        self._wire_selector(core)
+        if core.hg.accel is not None:
+            self._wire_accel(core.hg.accel)
+
+    # -- registration helpers ----------------------------------------------
+
+    def _histogram(self, name, buckets):
+        s = catalog.spec(name)
+        return self.registry.histogram(name, s.help, buckets, s.labels)
+
+    def _func(self, name, fn):
+        s = catalog.spec(name)
+        if s.kind == "counter":
+            self.registry.func_counter(name, s.help, fn, s.labels)
+        else:
+            self.registry.func_gauge(name, s.help, fn, s.labels)
+
+    # -- stage observation --------------------------------------------------
+
+    def _observe_stage_hist(self, stage: str, seconds: float) -> None:
+        child = self._stage_children.get(stage)
+        if child is None:
+            child = self._sync_stage.labels(stage=stage)
+            self._stage_children[stage] = child
+        child.observe(seconds)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """Histogram + active-trace stage record (no-op when disabled)."""
+        if self.stage_observer is not None:
+            self.stage_observer(stage, seconds)
+
+    def start_sync_trace(self, peer_id: int, kind: str = "sync"):
+        if not self.enabled:
+            return NULL_TRACE
+        return self.tracer.start(kind, peer_id)
+
+    # -- wiring -------------------------------------------------------------
+
+    def _wire_core(self, core) -> None:
+        self._func("ingest_syncs_total", lambda: core.ingest_syncs)
+        self._func(
+            "ingest_batch_verifies_total",
+            lambda: core.ingest_batch_verifies,
+        )
+        self._func(
+            "ingest_batch_size_max", lambda: core.ingest_batch_size_max
+        )
+        self._func(
+            "ingest_fallback_singles_total",
+            lambda: core.ingest_fallback_singles,
+        )
+        self._func(
+            "node_last_block_index", lambda: core.get_last_block_index()
+        )
+        self._func(
+            "node_last_consensus_round",
+            lambda: (
+                -1
+                if core.get_last_consensus_round_index() is None
+                else core.get_last_consensus_round_index()
+            ),
+        )
+        self._func(
+            "node_consensus_events",
+            lambda: core.get_consensus_events_count(),
+        )
+        self._func(
+            "node_undetermined_events",
+            lambda: len(core.get_undetermined_events()),
+        )
+        self._func(
+            "node_consensus_transactions_total",
+            lambda: core.get_consensus_transactions_count(),
+        )
+        self._func(
+            "node_peers", lambda: len(core.peer_selector.get_peers())
+        )
+
+    def _wire_mempool(self, m) -> None:
+        if self.enabled:
+            m.attach_telemetry(
+                self.commit_latency,
+                self._tx_stage.labels(stage="mempool_wait"),
+                self._tx_stage.labels(stage="consensus"),
+            )
+        self._func("mempool_pending", lambda: m.pending_count)
+        self._func("mempool_pending_bytes", lambda: m.pending_bytes)
+        self._func("mempool_inflight", lambda: len(m._inflight))
+        self._func("mempool_submitted_total", lambda: m.submitted)
+        self._func("mempool_accepted_total", lambda: m.accepted)
+        self._func(
+            "mempool_rejected_total",
+            lambda: {
+                "full": m.rejected_full,
+                "duplicate": m.rejected_dup,
+                "oversized": m.rejected_oversized,
+                "throttled": m.rejected_throttled,
+                "already_committed": m.committed_dedup_hits,
+            },
+        )
+        self._func("mempool_committed_total", lambda: m.committed_total)
+        self._func("mempool_evictions_total", lambda: m.evictions)
+        self._func("mempool_requeued_total", lambda: m.requeued)
+        self._func("mempool_commit_drops_total", lambda: m.commit_drops)
+        self._func("mempool_inflight_aged_total", lambda: m.inflight_aged)
+
+    def _wire_sentry(self, s) -> None:
+        self._func(
+            "sentry_quarantined_peers",
+            lambda: s.stats()["sentry_quarantined_peers"],
+        )
+        self._func(
+            "sentry_quarantines_total", lambda: s.quarantines_total
+        )
+        self._func(
+            "sentry_quarantine_deferrals_total",
+            lambda: s.quarantine_deferrals,
+        )
+        self._func("sentry_readmissions_total", lambda: s.readmissions)
+        self._func("sentry_refused_rpcs_total", lambda: s.refused_rpcs)
+        self._func("sentry_proofs", lambda: len(s._proofs))
+        self._func("sentry_rejects_total", lambda: dict(s.rejects))
+
+    def _wire_selector(self, core) -> None:
+        # The selector object is REPLACED on membership changes
+        # (Core.set_peers), so readers resolve it through the core on
+        # every scrape instead of capturing the instance.
+        # The two _peers gauges need a sweep over per-peer health state,
+        # which only stats() computes (under the selector lock); the
+        # plain counters are read as attributes so a scrape doesn't take
+        # the selector lock once per instrument. A short-TTL memo lets
+        # ONE sweep serve both gauges within a single collect pass.
+        sel_memo = {"t": -1.0, "v": None}
+
+        def _sel_stats():
+            now = time.monotonic()
+            if sel_memo["v"] is None or now - sel_memo["t"] > 0.05:
+                sel_memo["v"] = core.peer_selector.stats()
+                sel_memo["t"] = now
+            return sel_memo["v"]
+
+        for key in (
+            "selector_unhealthy_peers",
+            "selector_backed_off_peers",
+        ):
+            self._func(key, lambda k=key: _sel_stats()[k])
+        for attr in (
+            "backoff_skips",
+            "probe_picks",
+            "starvation_overrides",
+            "quarantine_skips",
+            "quarantine_overrides",
+        ):
+            self._func(
+                f"selector_{attr}_total",
+                lambda a=attr: getattr(core.peer_selector, a),
+            )
+
+    def _wire_accel(self, accel) -> None:
+        hist = self._histogram("accel_stage_seconds", STAGE_BUCKETS)
+        children: Dict[str, object] = {}
+
+        def observe(stage: str, seconds: float) -> None:
+            child = children.get(stage)
+            if child is None:
+                child = hist.labels(stage=stage)
+                children[stage] = child
+            child.observe(seconds)
+
+        if self.enabled:
+            accel.stage_observer = observe
+        self._func("accel_sweeps_total", lambda: accel.sweeps)
+        self._func("accel_fallbacks_total", lambda: accel.fallbacks)
+        self._func(
+            "accel_compile_waits_total", lambda: accel.compile_waits
+        )
+        self._func("accel_stale_drops_total", lambda: accel.stale_drops)
+        self._func(
+            "accel_rebuilds_total",
+            lambda: (
+                accel.window_state.rebuilds
+                if accel.window_state is not None
+                else 0
+            ),
+        )
+        self._func(
+            "accel_rows_delta_total", lambda: accel.rows_delta_total
+        )
+        self._func(
+            "accel_rows_reused_total", lambda: accel.rows_reused_total
+        )
+        self._func(
+            "accel_breaker_state",
+            lambda: {"closed": 0, "half_open": 1, "open": 2}.get(
+                accel.breaker.stats()["breaker_state"], -1
+            ),
+        )
+        self._func(
+            "accel_breaker_opens_total", lambda: accel.breaker.opens
+        )
+
+    def bind_node(self, node) -> None:
+        """Register the node-level instruments (RPC counters, queue
+        depth) once the Node wrapping this core exists."""
+        self._node = node
+        self._func("sync_requests_total", lambda: node.sync_requests)
+        self._func("sync_errors_total", lambda: node.sync_errors)
+        self._func("rpc_errors_total", lambda: dict(node.rpc_errors))
+        self._func(
+            "gossip_transport_errors_total",
+            lambda: node.gossip_transport_errors,
+        )
+        self._func(
+            "sync_limit_truncations_total",
+            lambda: node.sync_limit_truncations,
+        )
+        self._func("submit_queue_depth", lambda: node.submit_q.qsize())
+        self._func(
+            "core_lock_wait_seconds_total",
+            lambda: round(node.core_lock.wait_s_total, 6),
+        )
+        self._func(
+            "core_lock_acquisitions_total",
+            lambda: node.core_lock.acquisitions,
+        )
+
+    # -- views --------------------------------------------------------------
+
+    def commit_latency_ms(self) -> Dict[str, object]:
+        """p50/p90/p99 (ms) + sample count of the end-to-end commit
+        latency histogram — the north-star numbers."""
+        s = self.commit_latency.summary()
+        return {
+            "count": s["count"],
+            "p50_ms": None if s["p50"] is None else round(1e3 * s["p50"], 1),
+            "p90_ms": None if s["p90"] is None else round(1e3 * s["p90"], 1),
+            "p99_ms": None if s["p99"] is None else round(1e3 * s["p99"], 1),
+        }
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition: node registry + process-global."""
+        return self.registry.render() + GLOBAL.render()
+
+    def telemetry_view(self) -> Dict[str, object]:
+        """Structured JSON for /telemetry: every instrument (histograms
+        with computed p50/p90/p99) + the recent sync-trace ring."""
+        out: Dict[str, object] = {
+            "enabled": self.enabled,
+            "instruments": self.registry.snapshot(),
+            "global": GLOBAL.snapshot(),
+            "commit_latency_ms": self.commit_latency_ms(),
+            "recent_syncs": self.tracer.recent(),
+        }
+        if self._node is not None:
+            out["node"] = {
+                "id": self._node.get_id(),
+                "moniker": self._core.validator.moniker,
+                "state": str(self._node.get_state()),
+            }
+        return out
+
+    def value(self, name: str, **labels):
+        """Assertion helper: current value of one instrument."""
+        return self.registry.get(name, **labels)

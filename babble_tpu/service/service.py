@@ -2,7 +2,11 @@
 
 Reference semantics: /root/reference/src/service/service.go:20-272 —
 endpoints /stats, /block/{index}, /blocks/{start}?count=, /graph, /peers,
-/genesispeers, /validators/{round}, /history. Built on the stdlib
+/genesispeers, /validators/{round}, /history. Extended here with the
+telemetry surface (docs/observability.md): /metrics (Prometheus text
+exposition), /telemetry (structured JSON with computed percentiles and
+recent sync traces), /mempool, /suspects, and the /debug/* routes
+(timers, thread stacks, JAX profile capture). Built on the stdlib
 ThreadingHTTPServer (the reference rides http.DefaultServeMux so an
 in-process app can share the port; here an app can mount extra handlers
 via ``extra_routes``)."""
@@ -68,8 +72,17 @@ class Service:
             if path in self.extra_routes:
                 self.extra_routes[path](req)
                 return
+            if path == "/metrics":
+                # Prometheus text exposition (docs/observability.md):
+                # the node registry + the process-global registry.
+                self._send_text(req, 200, self.node.get_metrics_text())
+                return
             if path == "/stats":
                 body = self.node.get_stats()
+            elif path == "/telemetry":
+                # structured JSON twin of /metrics: instruments with
+                # computed p50/p90/p99 + the recent sync-trace ring
+                body = self.node.get_telemetry()
             elif path == "/mempool":
                 # admission knobs + live counters (docs/mempool.md)
                 body = self.node.get_mempool()
@@ -185,6 +198,17 @@ class Service:
         payload = json.dumps(body).encode()
         req.send_response(code)
         req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    @staticmethod
+    def _send_text(req: BaseHTTPRequestHandler, code: int, text: str) -> None:
+        payload = text.encode()
+        req.send_response(code)
+        req.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
         req.send_header("Content-Length", str(len(payload)))
         req.end_headers()
         req.wfile.write(payload)

@@ -9,6 +9,12 @@ retry instead of hammering a shedding node; retries exhausted count as
 shed. Totals (accepted / shed / duplicate / ...) print at exit.
 
 Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
+                               [--metrics=host:port,host:port,...]
+
+With ``--metrics``, each listed node's ``GET /metrics`` (the service's
+Prometheus endpoint, docs/observability.md) is scraped after the
+bombardment and its commit-latency p50/p90/p99 printed — the quickest
+way to see the north-star latency of a live testnet.
 
 Byzantine mode — drive the adversary harness (babble_tpu.adversary)
 against a live cluster outside pytest: point it at a compromised
@@ -34,6 +40,52 @@ from babble_tpu.common.backoff import jittered_backoff  # noqa: E402
 from babble_tpu.proxy.socket_proxy import JsonRpcClient  # noqa: E402
 
 MAX_RETRIES = 8  # per transaction, on throttled/full
+
+
+def scrape_commit_latency(endpoints: str, settle_s: float = 15.0) -> None:
+    """GET /metrics from each ``host:port`` and print commit-latency
+    percentiles computed from the Prometheus histogram buckets. Commits
+    lag the final submit, so an empty histogram is re-polled for up to
+    ``settle_s`` before being reported as empty."""
+    import urllib.request
+
+    from bench import _parse_prom_histogram, _prom_hist_quantile
+
+    for ep in endpoints.split(","):
+        ep = ep.strip()
+        if not ep:
+            continue
+        deadline = time.monotonic() + settle_s
+        hist = None
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"http://{ep}/metrics", timeout=5.0
+                ) as r:
+                    text = r.read().decode()
+            except Exception as err:
+                print(f"{ep}: scrape failed ({err})", file=sys.stderr)
+                hist = ()  # sentinel: failed scrape, not an empty histogram
+                break
+            hist = _parse_prom_histogram(text, "commit_latency_seconds")
+            if (hist is not None and hist["count"] > 0) or (
+                time.monotonic() >= deadline
+            ):
+                break
+            time.sleep(0.5)
+        if hist == ():
+            continue  # scrape failure already reported above
+        if hist is None or hist["count"] == 0:
+            print(f"{ep}: commit_latency_seconds empty (no local commits)")
+            continue
+        p50, p90, p99 = (
+            _prom_hist_quantile(hist, q) for q in (0.50, 0.90, 0.99)
+        )
+        print(
+            f"{ep}: commit latency n={hist['count']} "
+            f"p50={1e3 * p50:.0f}ms p90={1e3 * p90:.0f}ms "
+            f"p99={1e3 * p99:.0f}ms"
+        )
 
 
 def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> None:
@@ -146,6 +198,8 @@ def main() -> int:
     )
     if sent:
         print(f"shed rate: {counts['shed'] / sent:.3f}")
+    if "metrics" in opts:
+        scrape_commit_latency(opts["metrics"])
     return 0
 
 

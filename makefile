@@ -76,9 +76,22 @@ byzsmoke:
 byzstorm:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_byzantine.py -q -m "byz"
 
+# obssmoke: observability smoke — boot 3 nodes, commit txs, scrape every
+# node's /metrics over HTTP; asserts valid Prometheus text, a populated
+# commit_latency_seconds histogram, every cataloged instrument present,
+# and the BABBLE_OBS=0 kill-switch overhead ratio ≥ 0.97
+# (docs/observability.md)
+obssmoke:
+	JAX_PLATFORMS=cpu python bench.py --obs --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['obs_ok'], d; assert d['commit_latency_samples'] > 0, d; assert not d['missing_metrics'], d; oh=d.get('obs_overhead',{}); r=oh.get('ratio'); assert r is None or r >= 0.97, oh; print('obssmoke ok: clat p50', d['commit_latency_p50_ms'], 'ms, overhead ratio', r)"
+
+# metricslint: the instrument catalog and the docs table must match in
+# both directions (a new instrument cannot ship undocumented)
+metricslint:
+	python -m babble_tpu.obs.lint docs/observability.md
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint wheel

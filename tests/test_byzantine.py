@@ -233,6 +233,28 @@ def test_equivocation_soak_quarantine_proofs_and_restart(tmp_path):
             n.core.peer_selector.stats()["selector_quarantine_skips"] > 0
             for n in nodes
         )
+
+        # telemetry saw the attack (ISSUE-6: soaks assert on telemetry,
+        # not only end state): DURING the quarantine window the
+        # registry's sentry gauges/counters on a catching node show the
+        # quarantine and the fork evidence, and the Prometheus rendering
+        # of the same registry carries the fork-cause reject counter —
+        # the same facts through /metrics that get_stats reports.
+        caught = [n for n in nodes if n.core.sentry.is_quarantined(byz_id)]
+        assert caught
+        for n in caught:
+            t = n.telemetry
+            assert t.value("sentry_quarantined_peers") >= 1
+            assert t.value("sentry_quarantines_total") >= 1
+            assert t.value("sentry_proofs") >= 1
+            assert t.value("sentry_rejects_total", cause="fork") >= 1
+            rendered = t.render_metrics()
+            assert "sentry_quarantined_peers 1" in rendered
+            assert 'sentry_rejects_total{cause="fork"}' in rendered
+            # registry and get_stats agree on the quarantine count
+            assert n.get_stats()["sentry_quarantines_total"] == str(
+                t.value("sentry_quarantines_total")
+            )
         # bounded queues: the attack must not leave RPC backlogs
         for n in nodes:
             assert n.trans.consumer().qsize() < 256

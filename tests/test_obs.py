@@ -1,0 +1,431 @@
+"""Unit tests for the telemetry layer (babble_tpu/obs/):
+registry instruments + Prometheus rendering, span tracer, mempool
+latency feed, structured logging, catalog/docs lint, kill switch."""
+
+import io
+import json
+import logging
+import os
+
+import pytest
+
+from babble_tpu.obs import catalog as obs_catalog
+from babble_tpu.obs import lint as obs_lint
+from babble_tpu.obs import log as obs_log
+from babble_tpu.obs.metrics import (
+    GLOBAL,
+    Counter,
+    Gauge,
+    Histogram,
+    NULL,
+    Registry,
+)
+from babble_tpu.obs.trace import Tracer, staged
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs",
+                    "observability.md")
+
+
+# -- instruments -------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    c = Counter()
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    g = Gauge()
+    g.set(3.5)
+    g.inc()
+    g.dec(0.5)
+    assert g.value == 4.0
+
+
+def test_histogram_buckets_sum_count_and_quantiles():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(6.05)
+    # counts: <=0.1 -> 1, <=1.0 -> 2, <=10 -> 1, +Inf -> 0
+    assert h.counts == [1, 2, 1, 0]
+    # p50 lands in the (0.1, 1.0] bucket, interpolated
+    assert 0.1 < h.quantile(0.5) <= 1.0
+    assert h.quantile(0.99) <= 10.0
+    s = h.summary()
+    assert s["count"] == 4 and s["p50"] is not None
+    # Prometheus `le` is inclusive: a value ON a bound lands in that
+    # bucket, not the next one up
+    h.observe(1.0)
+    assert h.counts == [1, 3, 1, 0]
+
+
+def test_histogram_overflow_goes_to_inf_bucket():
+    h = Histogram(buckets=(1.0,))
+    h.observe(100.0)
+    assert h.counts == [0, 1]
+    assert h.quantile(0.5) == 1.0  # clamped to the largest finite bound
+
+
+def test_empty_histogram_quantile_is_none():
+    h = Histogram(buckets=(1.0,))
+    assert h.quantile(0.5) is None
+    assert h.summary()["p50"] is None
+
+
+# -- registry + exposition ---------------------------------------------------
+
+
+def test_registry_render_prometheus_text_shape():
+    r = Registry(enabled=True)
+    c = r.counter("foo_total", "help foo")
+    c.inc(3)
+    h = r.histogram("lat_seconds", "help lat", buckets=(0.5, 1.0))
+    h.observe(0.2)
+    h.observe(0.7)
+    ls = r.histogram(
+        "st_seconds", "help st", buckets=(1.0,), labelnames=("stage",)
+    )
+    ls.labels(stage="a").observe(0.1)
+    r.func_gauge("depth", "help depth", lambda: 7)
+    r.func_counter(
+        "byc_total", "by cause", lambda: {"x": 2}, labelnames=("cause",)
+    )
+    text = r.render()
+    assert "# HELP foo_total help foo" in text
+    assert "# TYPE foo_total counter" in text
+    assert "foo_total 3" in text
+    # cumulative buckets + +Inf + sum/count
+    assert 'lat_seconds_bucket{le="0.5"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_count 2" in text
+    assert 'st_seconds_bucket{stage="a",le="1"} 1' in text
+    assert "depth 7" in text
+    assert 'byc_total{cause="x"} 2' in text
+
+
+def test_registry_get_and_summary_helpers():
+    r = Registry(enabled=True)
+    c = r.counter("x_total", "x")
+    c.inc(2)
+    assert r.get("x_total") == 2
+    r.func_counter("y_total", "y", lambda: {"a": 4}, labelnames=("t",))
+    assert r.get("y_total", t="a") == 4
+    h = r.histogram("h_seconds", "h", buckets=(1.0,))
+    h.observe(0.5)
+    assert r.histogram_summary("h_seconds")["count"] == 1
+
+
+def test_registry_same_name_returns_same_instrument():
+    r = Registry(enabled=True)
+    a = r.counter("dup_total", "d")
+    b = r.counter("dup_total", "d")
+    a.inc()
+    assert b.value == 1
+
+
+def test_disabled_registry_returns_null_and_renders_only_funcs():
+    r = Registry(enabled=False)
+    c = r.counter("hot_total", "h")
+    assert c is NULL
+    c.inc()  # no-op, no crash
+    h = r.histogram("hot_seconds", "h")
+    h.observe(1.0)
+    assert h.labels(stage="x") is h
+    r.func_counter("cold_total", "c", lambda: 9)
+    text = r.render()
+    assert "hot_total" not in text
+    assert "cold_total 9" in text
+
+
+def test_snapshot_is_json_serializable():
+    r = Registry(enabled=True)
+    r.histogram("h_seconds", "h", buckets=(1.0,)).observe(0.2)
+    r.func_gauge("g", "g", lambda: None)  # failing/None reader tolerated
+    json.dumps(r.snapshot())
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_tracer_stages_attach_to_active_trace_and_ring():
+    seen = []
+    t = Tracer(stage_sink=lambda s, d: seen.append(s), ring=4)
+    tr = t.start("sync", peer_id=7)
+    with tr.stage("request_sync"):
+        pass
+    t.observe("insert", 0.001)  # deep-pipeline observation, no explicit trace
+    tr.finish()
+    assert seen == ["request_sync", "insert"]
+    assert t.active() is None
+    recent = t.recent()
+    assert len(recent) == 1
+    rec = recent[0]
+    assert rec["peer"] == 7 and rec["kind"] == "sync"
+    assert [s for s, _ in tr.stages] == ["request_sync", "insert"]
+    # ring is bounded
+    for _ in range(10):
+        t.start("sync", 1).finish()
+    assert len(t.recent()) == 4
+
+
+def test_observe_without_active_trace_only_hits_sink():
+    seen = []
+    t = Tracer(stage_sink=lambda s, d: seen.append((s, d)))
+    t.observe("divide_rounds", 0.5)
+    assert seen == [("divide_rounds", 0.5)]
+    assert t.recent() == []
+
+
+def test_staged_decorator_null_observer_is_clockless():
+    calls = []
+
+    class Obj:
+        stage_observer = None
+
+        @staged("insert")
+        def work(self, x):
+            return x * 2
+
+    o = Obj()
+    assert o.work(3) == 6
+    o.stage_observer = lambda s, d: calls.append((s, d))
+    assert o.work(4) == 8
+    assert len(calls) == 1 and calls[0][0] == "insert"
+    assert calls[0][1] >= 0.0
+
+
+# -- mempool latency feed ----------------------------------------------------
+
+
+def test_mempool_commit_latency_observed_with_fake_clock():
+    from babble_tpu.mempool import Mempool
+
+    now = {"t": 100.0}
+    m = Mempool(max_txs=10, max_bytes=10**6, clock=lambda: now["t"])
+    lat, wait, cons = (
+        Histogram(buckets=(0.5, 2.0, 10.0)),
+        Histogram(buckets=(0.5, 2.0, 10.0)),
+        Histogram(buckets=(0.5, 2.0, 10.0)),
+    )
+    m.attach_telemetry(lat, wait, cons)
+    assert m.submit(b"tx1") == "accepted"
+    now["t"] = 101.0  # 1 s in the pool
+    drained = m.drain()
+    assert drained == [b"tx1"]
+    assert wait.count == 1 and wait.sum == pytest.approx(1.0)
+    now["t"] = 103.0  # 2 s in consensus
+    m.mark_committed([b"tx1"])
+    assert lat.count == 1 and lat.sum == pytest.approx(3.0)
+    assert cons.count == 1 and cons.sum == pytest.approx(2.0)
+    # internals fully cleaned up
+    assert not m._admit_ts and not m._drain_ts
+
+
+def test_mempool_requeue_keeps_admit_clock_running():
+    from babble_tpu.mempool import Mempool
+
+    now = {"t": 0.0}
+    m = Mempool(max_txs=10, max_bytes=10**6, clock=lambda: now["t"])
+    lat, wait, cons = (Histogram((10.0,)), Histogram((10.0,)),
+                       Histogram((10.0,)))
+    m.attach_telemetry(lat, wait, cons)
+    m.submit(b"tx")
+    now["t"] = 1.0
+    batch = m.drain()
+    m.requeue(batch)  # event creation failed
+    now["t"] = 2.0
+    m.drain()
+    # mempool_wait observed exactly ONCE per tx (admit t=0 → FIRST
+    # drain t=1), never re-observed by the post-requeue drain
+    assert wait.count == 1 and wait.sum == pytest.approx(1.0)
+    now["t"] = 5.0
+    m.mark_committed([b"tx"])
+    # end-to-end from the ORIGINAL admit (t=0), not the requeue
+    assert lat.sum == pytest.approx(5.0)
+    # consensus leg from the FIRST drain (t=1): requeue interludes
+    # count as consensus time, and wait+consensus == end-to-end
+    assert cons.count == 1 and cons.sum == pytest.approx(4.0)
+    assert not m._admit_ts and not m._drain_ts
+
+
+def test_mempool_without_telemetry_records_no_timestamps():
+    from babble_tpu.mempool import Mempool
+
+    m = Mempool(max_txs=4, max_bytes=10**6)
+    m.submit(b"a")
+    m.drain()
+    m.mark_committed([b"a"])
+    assert not m._admit_ts and not m._drain_ts
+
+
+# -- node wiring vs catalog --------------------------------------------------
+
+
+def _tiny_node():
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import PrivateKey
+    from babble_tpu.dummy.state import State
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.inmem import InmemNetwork
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+    from babble_tpu.proxy.proxy import InmemProxy
+
+    key = PrivateKey(0xFEED)
+    peers = PeerSet([Peer("inmem://solo", key.public_key.hex(), "solo")])
+    net = InmemNetwork()
+    conf = Config(heartbeat_timeout=0.01, log_level="error", moniker="solo")
+    node = Node(
+        conf, Validator(key, "solo"), peers, peers,
+        InmemStore(conf.cache_size), net.new_transport("inmem://solo"),
+        InmemProxy(State()),
+    )
+    return node
+
+
+def test_node_registry_matches_catalog_exactly():
+    """Every node-scope cataloged instrument is registered on a plain
+    (oracle) node, and nothing outside the catalog can register — the
+    two-way contract the docs lint rides on."""
+    node = _tiny_node()
+    try:
+        registered = set(node.telemetry.registry.names())
+        expected = {
+            c.name for c in obs_catalog.CATALOG if c.scope == "node"
+        }
+        assert registered == expected
+        global_expected = {
+            c.name for c in obs_catalog.CATALOG if c.scope == "global"
+        }
+        assert global_expected <= set(GLOBAL.names())
+    finally:
+        node.shutdown()
+
+
+def test_uncataloged_instrument_registration_raises():
+    with pytest.raises(KeyError):
+        obs_catalog.spec("totally_unknown_metric")
+
+
+def test_get_stats_is_string_view_of_typed_snapshot():
+    node = _tiny_node()
+    try:
+        snap = node.get_stats_snapshot()
+        stats = node.get_stats()
+        assert isinstance(snap["last_block_index"], int)
+        assert isinstance(snap["mempool_pending"], int)
+        assert set(stats) == set(snap)
+        for k, v in snap.items():
+            assert stats[k] == str(v)
+        json.dumps(snap)  # the mobile surface contract
+    finally:
+        node.shutdown()
+
+
+def test_kill_switch_disables_hot_path_only(monkeypatch):
+    """BABBLE_OBS=0: stage observers are None (no clock reads), but the
+    func-backed instruments keep serving /metrics and get_stats."""
+    import babble_tpu.obs.metrics as metrics_mod
+
+    monkeypatch.setattr(metrics_mod, "_ENABLED", False)
+    node = _tiny_node()
+    try:
+        t = node.telemetry
+        assert not t.enabled
+        assert t.stage_observer is None
+        assert t.lock_wait_observer is None
+        assert node.core.hg.stage_observer is None
+        assert t.start_sync_trace(1).trace_id == 0  # null trace
+        text = t.render_metrics()
+        assert "ingest_syncs_total 0" in text
+        assert "commit_latency_seconds" not in text
+        # legacy stats still intact
+        assert node.get_stats()["ingest_syncs"] == "0"
+    finally:
+        node.shutdown()
+
+
+# -- metrics lint ------------------------------------------------------------
+
+
+def test_metrics_lint_passes_on_shipped_docs():
+    assert obs_lint.run(DOCS) == 0
+
+
+def test_metrics_lint_catches_drift(tmp_path):
+    rows = "\n".join(
+        f"| `{c.name}` | {c.kind} | | {c.scope} | x |"
+        for c in obs_catalog.CATALOG
+        if c.name != "commit_latency_seconds"
+    )
+    doc = tmp_path / "obs.md"
+    doc.write_text(
+        "<!-- metrics-table-start -->\n"
+        f"{rows}\n| `made_up_metric` | counter | | node | x |\n"
+        "<!-- metrics-table-end -->\n"
+    )
+    assert obs_lint.run(str(doc)) == 1
+
+
+def test_lint_rejects_docs_without_markers(tmp_path):
+    doc = tmp_path / "no_markers.md"
+    doc.write_text("# nothing here\n")
+    with pytest.raises(SystemExit):
+        obs_lint.run(str(doc))
+
+
+# -- structured logging ------------------------------------------------------
+
+
+def test_log_configure_json_emits_parseable_lines():
+    buf = io.StringIO()
+    obs_log.configure(level="info", json_mode=True, node="n0", node_id=42,
+                      stream=buf)
+    # unique logger name: cluster suites set e.g. babble_tpu.node.n0 to
+    # ERROR via Config.logger, which would swallow this INFO record
+    logger = logging.getLogger("babble_tpu.node.obs_json_test")
+    logger.info("hello %s", "world", extra={"peer": 7, "sync_id": 99})
+    line = buf.getvalue().strip()
+    rec = json.loads(line)
+    assert rec["msg"] == "hello world"
+    assert rec["level"] == "info"
+    assert rec["node"] == "n0" and rec["node_id"] == 42
+    assert rec["peer"] == 7 and rec["sync_id"] == 99
+    assert rec["logger"] == "babble_tpu.node.obs_json_test"
+
+
+def test_log_configure_is_idempotent_and_plain_mode_works():
+    buf1 = io.StringIO()
+    buf2 = io.StringIO()
+    obs_log.configure(level="info", json_mode=False, stream=buf1)
+    obs_log.configure(level="info", json_mode=False, stream=buf2)
+    root = logging.getLogger(obs_log.ROOT)
+    tagged = [
+        h for h in root.handlers if getattr(h, "_babble_obs_handler", False)
+    ]
+    assert len(tagged) == 1  # reconfigure replaced, not stacked
+    logging.getLogger("babble_tpu.test").warning("plain line")
+    assert "plain line" in buf2.getvalue()
+    assert buf1.getvalue() == ""
+
+
+def test_config_logger_scopes_under_framework_root():
+    from babble_tpu.config.config import Config
+
+    conf = Config(moniker="m1", log_level="warning")
+    lg = conf.logger("node")
+    assert lg.name == "babble_tpu.node.m1"
+    assert lg.level == logging.WARNING
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs_logging():
+    yield
+    root = logging.getLogger(obs_log.ROOT)
+    for h in list(root.handlers):
+        if getattr(h, "_babble_obs_handler", False):
+            root.removeHandler(h)

@@ -1,0 +1,259 @@
+"""HTTP service endpoint coverage (satellite of the telemetry ISSUE):
+the previously-untested /debug/* routes plus the new /metrics and
+/telemetry surfaces, with Prometheus parse + histogram monotonicity
+checks against a live gossiping cluster."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+from babble_tpu.service.service import Service
+from babble_tpu.dummy.state import State
+
+
+def _get(base, path, timeout=10.0):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def _parse_prom(text):
+    """Minimal Prometheus text parser: {(name, labelstr): float} plus
+    the set of TYPE-declared metric names. Raises on malformed lines —
+    the 'parses as Prometheus text' assertion."""
+    samples = {}
+    declared = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ")
+            assert parts[1] in ("HELP", "TYPE"), line
+            if parts[1] == "TYPE":
+                declared.add(parts[2])
+                assert parts[3] in ("counter", "gauge", "histogram"), line
+            continue
+        key, _, value = line.rpartition(" ")
+        assert key and value, line
+        float(value)  # must parse
+        samples[key] = float(value)
+    return samples, declared
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """Two gossiping in-mem nodes, node 0 fronted by a live Service."""
+    net = InmemNetwork()
+    keys = [generate_key() for _ in range(2)]
+    peers = PeerSet(
+        [
+            Peer(f"inmem://s{i}", k.public_key.hex(), f"s{i}")
+            for i, k in enumerate(keys)
+        ]
+    )
+    addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.01,
+            slow_heartbeat_timeout=0.2,
+            log_level="error",
+            moniker=f"s{i}",
+        )
+        st = State()
+        pr = InmemProxy(st)
+        n = Node(
+            conf, Validator(k, f"s{i}"), peers, peers,
+            InmemStore(conf.cache_size),
+            net.new_transport(addr[k.public_key.hex()]), pr,
+        )
+        n.init()
+        nodes.append(n)
+        proxies.append(pr)
+        states.append(st)
+    for n in nodes:
+        n.run_async()
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+
+    def commit(n_txs, tag):
+        start = len(states[0].committed_txs)
+        deadline = time.monotonic() + 60
+        i = 0
+        while (
+            len(states[0].committed_txs) - start < n_txs
+            and time.monotonic() < deadline
+        ):
+            proxies[i % 2].submit_tx(f"{tag} {i}".encode())
+            i += 1
+            time.sleep(0.005)
+        assert len(states[0].committed_txs) - start >= n_txs
+
+    commit(20, "warm")
+    base = f"http://{svc.bind_addr}"
+    yield base, nodes, proxies, states, commit
+    svc.shutdown()
+    for n in nodes:
+        n.shutdown()
+
+
+def test_metrics_serves_valid_prometheus_text(cluster):
+    base, nodes, *_ = cluster
+    ctype, text = _get(base, "/metrics")
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    samples, declared = _parse_prom(text)
+    # the two headline histograms of the ISSUE
+    assert "commit_latency_seconds" in declared
+    assert "sync_stage_seconds" in declared
+    assert samples['commit_latency_seconds_bucket{le="+Inf"}'] > 0
+    # per-stage children rendered with labels
+    assert any(
+        k.startswith('sync_stage_seconds_count{stage="insert"}')
+        for k in samples
+    )
+    # func-backed counters present with live values
+    assert samples["ingest_syncs_total"] > 0
+    # >= 0, not >= 1: indices start at -1 (no blocks) and a fast run
+    # can pack every warm-up tx into the single block index 0
+    assert samples["node_last_block_index"] >= 0
+    # process-global cache metrics ride along
+    assert "wire_cache_hits_total" in samples
+
+
+def test_metrics_histograms_monotone_across_syncs(cluster):
+    base, nodes, proxies, states, commit = cluster
+    _, text1 = _get(base, "/metrics")
+    s1, _ = _parse_prom(text1)
+    commit(15, "mono")
+    # commit_latency_seconds only observes txs admitted by node 0's OWN
+    # mempool, and the 15 commits above can land entirely inside other
+    # creators' event batches — keep feeding node 0 and re-scraping
+    # until ITS histogram advances instead of trusting any-15-commits
+    inf_key = 'commit_latency_seconds_bucket{le="+Inf"}'
+    deadline = time.monotonic() + 60
+    i = 0
+    while True:
+        _, text2 = _get(base, "/metrics")
+        s2, _ = _parse_prom(text2)
+        if s2[inf_key] > s1[inf_key]:
+            break
+        assert (
+            time.monotonic() < deadline
+        ), "node-0 commit-latency histogram never advanced"
+        proxies[0].submit_tx(f"mono-n0 {i}".encode())
+        i += 1
+        time.sleep(0.01)
+    grew = False
+    for key, v1 in s1.items():
+        if "_bucket" in key or key.endswith("_count"):
+            assert s2.get(key, 0) >= v1, f"{key} went backwards"
+            if s2.get(key, 0) > v1:
+                grew = True
+    assert grew, "no histogram count advanced across commits"
+
+
+def test_telemetry_json_view(cluster):
+    base, nodes, *_ = cluster
+    ctype, text = _get(base, "/telemetry")
+    assert ctype.startswith("application/json")
+    body = json.loads(text)
+    assert body["enabled"] is True
+    assert body["node"]["moniker"] == "s0"
+    clat = body["commit_latency_ms"]
+    assert clat["count"] > 0 and clat["p50_ms"] is not None
+    assert clat["p50_ms"] <= clat["p99_ms"]
+    inst = body["instruments"]
+    assert inst["ingest_syncs_total"] > 0
+    # recent sync traces: id/peer/total_ms/ordered stages
+    traces = body["recent_syncs"]
+    assert traces, "no sync traces recorded"
+    tr = traces[-1]
+    assert tr["kind"] == "sync" and tr["total_ms"] >= 0
+    stages = [s for s, _ in tr["stages"]]
+    assert "request_sync" in stages
+
+
+def test_stats_carries_commit_latency_percentiles(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/stats")
+    stats = json.loads(text)
+    assert int(stats["commit_latency_samples"]) > 0
+    assert float(stats["commit_latency_p50_ms"]) > 0
+    # reference-parity contract: every value is a string
+    assert all(isinstance(v, str) for v in stats.values())
+
+
+def test_debug_timers_endpoint(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/debug/timers")
+    timers = json.loads(text)
+    assert "request_sync" in timers
+    rs = timers["request_sync"]
+    assert rs["count"] > 0 and rs["p50_ms"] >= 0
+
+
+def test_debug_stacks_endpoint(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/debug/stacks")
+    stacks = json.loads(text)
+    assert stacks, "no thread stacks returned"
+    assert any("MainThread" in k for k in stacks)
+
+
+def test_debug_profile_endpoint(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/debug/profile?seconds=0.2", timeout=60.0)
+    body = json.loads(text)
+    # jax present in the test env: a real capture lands in /tmp; if the
+    # profiler is unavailable the route still answers structured JSON
+    assert "trace_dir" in body or "error" in body
+    if "trace_dir" in body:
+        assert body["seconds"] == 0.2
+
+
+def test_debug_profile_rejects_bad_seconds(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/debug/profile?seconds=nope", timeout=60.0)
+    body = json.loads(text)
+    if "seconds" in body:
+        assert body["seconds"] == 3.0  # clamped to the default
+
+
+def test_graph_endpoint(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/graph")
+    graph = json.loads(text)
+    assert len(graph["ParticipantEvents"]) == 2
+    assert graph["Blocks"], "graph carries no blocks"
+    assert "Rounds" in graph
+
+
+def test_history_endpoint(cluster):
+    base, *_ = cluster
+    _, text = _get(base, "/history")
+    history = json.loads(text)
+    assert "0" in history
+    assert len(history["0"]) == 2
+
+
+def test_unknown_route_is_404_and_blocks_route_errors(cluster):
+    base, *_ = cluster
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(f"{base}/definitely/not/a/route")
+    assert exc.value.code == 404
+    # /blocks past the tip -> structured 500
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(f"{base}/blocks/999999")
+    assert exc.value.code == 500
