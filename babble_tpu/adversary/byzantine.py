@@ -44,7 +44,6 @@ import logging
 import queue
 import random
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from ..config.config import Config
@@ -79,9 +78,12 @@ class ByzantineCore(Core):
         peers: PeerSet,
         genesis_peers: PeerSet,
         store: Store,
+        clock=None,
+        selector_rng=None,
     ):
         super().__init__(
-            validator, peers, genesis_peers, store, dummy_commit_response
+            validator, peers, genesis_peers, store, dummy_commit_response,
+            clock=clock, selector_rng=selector_rng,
         )
         # the second branch of a minted fork, by chain position (index)
         self.forks: Dict[int, Event] = {}
@@ -100,7 +102,7 @@ class ByzantineCore(Core):
         (correctly) refuse it."""
         parents = [self.head, other_head]
         index = self.seq + 1
-        ts = int(time.time())
+        ts = int(self.clock.time())
         a = Event.new(
             txs_a, [], [], parents, self.validator.public_key_bytes(), index,
             timestamp=ts,
@@ -131,7 +133,7 @@ class ByzantineCore(Core):
                 [self.head, ""],
                 self.validator.public_key_bytes(),
                 self.seq + 1,
-                timestamp=int(time.time()),
+                timestamp=int(self.clock.time()),
             )
             ev.sign(mallory)
             try:
@@ -206,7 +208,11 @@ class ByzantineNode:
         if attack not in ATTACKS:
             raise ValueError(f"unknown attack {attack!r}; pick from {ATTACKS}")
         self.conf = conf
-        self.core = ByzantineCore(validator, peers, genesis_peers, store)
+        self.core = ByzantineCore(
+            validator, peers, genesis_peers, store,
+            clock=conf.clock,
+            selector_rng=conf.seeded_rng("selector", validator.id()),
+        )
         self.trans = trans
         self.attack = attack
         self.fork_height = fork_height

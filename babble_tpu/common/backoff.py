@@ -32,3 +32,26 @@ def jittered_backoff(
          else random.uniform(-1.0, 1.0))
     nominal = base_s * (2.0 ** min(attempt - 1, 32))
     return min(cap_s, nominal * (1.0 + jitter * u))
+
+
+def backoff_sleep(
+    attempt: int,
+    base_s: float,
+    cap_s: float,
+    jitter: float = 0.25,
+    rng: Optional[random.Random] = None,
+    sleep=None,
+) -> float:
+    """Compute the jittered delay AND wait it out through an injectable
+    ``sleep`` (default: the process wall clock). Retry sites pass their
+    node's ``Clock.sleep`` so a simulated cluster's backoff waits are
+    virtual — a joining node's 2 s retry cadence costs the sim engine
+    nothing but a clock advance. Returns the delay actually slept."""
+    delay = jittered_backoff(attempt, base_s, cap_s, jitter, rng)
+    if delay > 0.0:
+        if sleep is None:
+            import time
+
+            sleep = time.sleep
+        sleep(delay)
+    return delay

@@ -44,7 +44,10 @@ class CircuitBreaker:
         self.threshold = threshold
         self.window_s = window_s
         self.cooldown_s = cooldown_s
-        self._clock = clock
+        # Accept either a bare monotonic callable or a common.clock.Clock
+        # object (the node hands its Clock through, so simulated breakers
+        # trip and cool down on virtual time).
+        self._clock = getattr(clock, "monotonic", clock)
         self._lock = threading.Lock()
         self._state = CLOSED
         self._failures: List[float] = []  # timestamps inside the window

@@ -22,9 +22,9 @@ class TimedLock:
     """Drop-in ``threading.Lock`` replacement that records total time
     spent *waiting* to acquire (contention, not hold time)."""
 
-    __slots__ = ("_lock", "wait_s_total", "acquisitions", "observer")
+    __slots__ = ("_lock", "wait_s_total", "acquisitions", "observer", "_clock")
 
-    def __init__(self, observer=None) -> None:
+    def __init__(self, observer=None, clock=time.perf_counter) -> None:
         self._lock = threading.Lock()
         self.wait_s_total: float = 0.0
         self.acquisitions: int = 0
@@ -32,6 +32,8 @@ class TimedLock:
         # node wires the core_lock_wait_seconds histogram here; only
         # contended acquires are observed (the fast path stays clockless).
         self.observer = observer
+        # Injectable so simulated nodes account waits in virtual time.
+        self._clock = clock
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         # Fast path: an uncontended acquire skips the two clock reads —
@@ -41,9 +43,9 @@ class TimedLock:
             return True
         if not blocking:
             return False
-        t0 = time.perf_counter()
+        t0 = self._clock()
         ok = self._lock.acquire(True, timeout)
-        waited = time.perf_counter() - t0
+        waited = self._clock() - t0
         self.wait_s_total += waited
         if self.observer is not None:
             self.observer(waited)

@@ -113,6 +113,17 @@ class Config:
     maintenance_mode: bool = False
     moniker: str = ""
 
+    # Time source (common/clock.py): every node-side deadline, sleep,
+    # duration measurement, and event timestamp reads through this
+    # object. None -> the process wall clock. The deterministic
+    # simulation engine (babble_tpu.sim, docs/simulation.md) injects a
+    # SimClock here so whole fault scenarios run in virtual time.
+    clock: object = None
+    # Seed for the node's internal RNG streams (peer-selector pick
+    # weighting). None -> OS entropy (production). The sim harness sets
+    # it so gossip partner choice is a pure function of the master seed.
+    sim_seed: object = None
+
     # TPU acceleration: route batch verification and the DAG consensus
     # sweeps through the JAX kernels in babble_tpu.ops.
     accelerator: bool = False
@@ -122,6 +133,10 @@ class Config:
     accelerator_mesh: int = 0
 
     def __post_init__(self) -> None:
+        if self.clock is None:
+            from ..common.clock import WALL
+
+            self.clock = WALL
         if not self.database_dir:
             self.database_dir = os.path.join(self.data_dir, DEFAULT_BADGER_DIR)
         # Option forcing (reference: babble/babble.go:133-143):
@@ -135,6 +150,19 @@ class Config:
                 f"mempool_overflow must be 'reject' or 'evict-oldest', "
                 f"got {self.mempool_overflow!r}"
             )
+
+    def seeded_rng(self, stream: str, ident) -> object:
+        """Per-actor, per-stream ``random.Random`` derived from the master
+        sim seed, or None in production (``sim_seed`` unset) — call sites
+        fall back to the process-global random module. The seed string
+        ``"{sim_seed}|{stream}|{ident}"`` is a determinism contract: every
+        actor (honest node.py, adversary byzantine.py) must derive a given
+        stream through THIS helper so same-seed replays stay reproducible."""
+        if self.sim_seed is None:
+            return None
+        import random
+
+        return random.Random(f"{self.sim_seed}|{stream}|{ident}")
 
     def keyfile_path(self) -> str:
         return os.path.join(self.data_dir, DEFAULT_KEYFILE)

@@ -14,16 +14,29 @@ insert (src/hashgraph/hashgraph.go:672-687 -> src/crypto/keys/signature.go:20).
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import threading
+from typing import List, Optional, Tuple
 
 from babble_tpu import native_crypto
+from babble_tpu.common.lru import LRU
 from babble_tpu.crypto import secp256k1 as ref
+from babble_tpu.crypto.canonical import CacheStats
 from babble_tpu.crypto.keys import decode_signature
 
 # ((x, y), msg_hash, r, s)
 SigItem = Tuple[Tuple[int, int], bytes, int, int]
 # (event, first_item_index, item_count, statically_ok)
 SigSpan = Tuple[object, int, int, bool]
+
+# Process-wide verdict cache: a signature's validity is a pure function
+# of (pubkey, msg_hash, r, s), so events that arrive again — pushed by a
+# second peer, replayed by an adversary, re-decoded after a chaos retry
+# — skip the native verify entirely. Per-Event verdict caching
+# (Event._sig_ok) cannot catch these: every wire decode builds a fresh
+# Event object. Bounded LRU; the lock covers concurrent gossip threads.
+VERIFY_CACHE = CacheStats()
+_VERDICTS = LRU(32768)
+_VERDICTS_LOCK = threading.Lock()
 
 
 def available() -> bool:
@@ -70,16 +83,35 @@ def prevalidate_events_host(events) -> bool:
     when the native library is unavailable.
     """
     items, spans = collect_signature_items(events)
-    pubs = [
-        x.to_bytes(32, "big") + y.to_bytes(32, "big") for (x, y), _, _, _ in items
-    ]
-    msgs = [m for _, m, _, _ in items]
-    rss = [(r, s) for _, _, r, s in items]
-
-    results = native_crypto.verify_batch(pubs, msgs, rss)
-    if results is None:
-        return False
+    verdicts: List[Optional[bool]] = []
+    fresh: List[int] = []
+    with _VERDICTS_LOCK:
+        for it in items:
+            v, ok = _VERDICTS.get(it)
+            if ok:
+                VERIFY_CACHE.hits += 1
+                verdicts.append(v)
+            else:
+                VERIFY_CACHE.misses += 1
+                verdicts.append(None)
+                fresh.append(len(verdicts) - 1)
+    if fresh:
+        pubs = []
+        msgs = []
+        rss = []
+        for i in fresh:
+            (x, y), m, r, s = items[i]
+            pubs.append(x.to_bytes(32, "big") + y.to_bytes(32, "big"))
+            msgs.append(m)
+            rss.append((r, s))
+        results = native_crypto.verify_batch(pubs, msgs, rss)
+        if results is None:
+            return False
+        with _VERDICTS_LOCK:
+            for i, ok in zip(fresh, results):
+                verdicts[i] = bool(ok)
+                _VERDICTS.add(items[i], bool(ok))
     for ev, start, count, ok_static in spans:
-        ok = ok_static and all(results[start : start + count])
+        ok = ok_static and all(verdicts[start : start + count])
         ev.prevalidate(ok)
     return True

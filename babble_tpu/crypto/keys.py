@@ -198,7 +198,12 @@ class PrivateKey:
         # Signing touches the private key, so constant-time OpenSSL stays
         # preferred; the variable-time native signer is only a fallback
         # (verification is secret-free and uses native first).
-        if _HAVE_OPENSSL:
+        # EXCEPT under deterministic mode (the sim engine): OpenSSL draws
+        # a random ECDSA nonce, and the consensus total order breaks
+        # Lamport-timestamp ties on the signature's r value — so random
+        # nonces would make two same-seed sim runs commit in different
+        # orders. The native and pure-Python signers are RFC 6979.
+        if _HAVE_OPENSSL and not _DETERMINISTIC_SIGNING:
             try:
                 der = _openssl_priv(self.d).sign(
                     msg_hash, _ec.ECDSA(_Prehashed(_hashes.SHA256()))
@@ -232,6 +237,22 @@ class PrivateKey:
     @staticmethod
     def from_hex(s: str) -> "PrivateKey":
         return PrivateKey.from_bytes(bytes.fromhex(s.strip()))
+
+
+# Process-wide switch: when True, sign_rs skips the randomized-nonce
+# OpenSSL path and uses the RFC 6979 deterministic signers (native C++,
+# else pure Python). The sim engine flips this on so signatures — and
+# therefore the signature-r consensus tie-break — are pure functions of
+# (key, message), which byte-identical replay requires.
+_DETERMINISTIC_SIGNING = False
+
+
+def set_deterministic_signing(on: bool) -> bool:
+    """Toggle RFC 6979-only signing; returns the previous setting."""
+    global _DETERMINISTIC_SIGNING
+    prev = _DETERMINISTIC_SIGNING
+    _DETERMINISTIC_SIGNING = bool(on)
+    return prev
 
 
 def generate_key() -> PrivateKey:

@@ -40,11 +40,13 @@ from babble_tpu.common.errors import StoreError
 logger = logging.getLogger("babble_tpu.hashgraph.accel")
 
 
-def _breaker_from_env() -> CircuitBreaker:
+def _breaker_from_env(clock=None) -> CircuitBreaker:
     """Device-path circuit breaker with env-tunable parameters: open after
     BABBLE_ACCEL_BREAKER_N failures within BABBLE_ACCEL_BREAKER_WINDOW_S
     seconds, refuse the device for BABBLE_ACCEL_BREAKER_COOLDOWN_S, then
-    probe one sweep to half-open/re-close."""
+    probe one sweep to half-open/re-close. ``clock`` (a common.clock.Clock
+    or bare monotonic callable) makes the trip window and cooldown run on
+    the node's time source — virtual under the sim engine."""
     import os
 
     return CircuitBreaker(
@@ -53,6 +55,7 @@ def _breaker_from_env() -> CircuitBreaker:
         cooldown_s=float(
             os.environ.get("BABBLE_ACCEL_BREAKER_COOLDOWN_S", "15")
         ),
+        **({"clock": clock} if clock is not None else {}),
     )
 
 
@@ -194,7 +197,8 @@ class TensorConsensus:
                  mesh=None,
                  batcher: bool | None = None,
                  resident: bool | None = None,
-                 breaker: CircuitBreaker | None = None):
+                 breaker: CircuitBreaker | None = None,
+                 clock=None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -245,7 +249,8 @@ class TensorConsensus:
         # the path once the device answers again. This replaces any notion
         # of a sticky "disable forever" kill-switch: degradation is always
         # recoverable.
-        self.breaker = breaker if breaker is not None else _breaker_from_env()
+        self.breaker = (breaker if breaker is not None
+                        else _breaker_from_env(clock))
         self.sweeps = 0
         self.fallbacks = 0
         self.compile_waits = 0

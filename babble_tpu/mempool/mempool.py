@@ -128,8 +128,10 @@ class Mempool:
 
     @classmethod
     def from_config(cls, conf) -> "Mempool":
-        """Build from a ``Config`` (mempool_* knobs)."""
+        """Build from a ``Config`` (mempool_* knobs + the node clock, so
+        simulated nodes rate-limit and stamp latencies in virtual time)."""
         return cls(
+            clock=conf.clock.monotonic,
             max_txs=conf.mempool_max_txs,
             max_bytes=conf.mempool_max_bytes,
             overflow=conf.mempool_overflow,

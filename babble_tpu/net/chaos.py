@@ -105,9 +105,19 @@ class ChaosController:
         default_faults: Optional[LinkFaults] = None,
         drop_hold_s: float = 0.05,
         reorder_hold_s: float = 0.05,
+        sleep=None,
+        spawn=None,
     ):
         self.seed = seed_from_env() if seed is None else seed
         self.default_faults = default_faults or LinkFaults()
+        # Injectable concurrency primitives: latency faults, drop holds,
+        # and duplicate deliveries go through these, so the sim engine
+        # (docs/simulation.md) can run a whole nemesis storm in virtual
+        # time on one thread. Defaults are the real thing. ``spawn(fn)``
+        # runs a side task (duplicate delivery) — default a daemon
+        # thread, inline under the sim.
+        self.sleep = sleep if sleep is not None else time.sleep
+        self.spawn = spawn
         # How long a caller waits on a dropped/partitioned request before
         # the TransportError lands — a miniature RPC timeout, kept small so
         # chaos soaks fail links fast instead of serializing on the real
@@ -320,12 +330,12 @@ class ChaosTransport:
             ctl._count("reorders")
         if hold > 0.0:
             ctl._add_delay(hold)
-            time.sleep(hold)
+            ctl.sleep(hold)
         if plan.blocked_forward or plan.drop:
             ctl._count(
                 "blocked_requests" if plan.blocked_forward else "drops"
             )
-            time.sleep(ctl.drop_hold_s)
+            ctl.sleep(ctl.drop_hold_s)
             raise TransportError(
                 f"chaos: request {src} -> {target} "
                 + ("blocked by partition" if plan.blocked_forward else "dropped")
@@ -344,13 +354,16 @@ class ChaosTransport:
                 except Exception:
                     pass  # the duplicate's outcome is invisible to the caller
 
-            threading.Thread(target=dup, daemon=True,
-                             name="chaos-duplicate").start()
+            if ctl.spawn is not None:
+                ctl.spawn(dup)
+            else:
+                threading.Thread(target=dup, daemon=True,
+                                 name="chaos-duplicate").start()
         result = send(target, req)
         if plan.blocked_reverse:
             # the server processed the request; only the response vanished
             ctl._count("blocked_responses")
-            time.sleep(ctl.drop_hold_s)
+            ctl.sleep(ctl.drop_hold_s)
             raise TransportError(
                 f"chaos: response {target} -> {src} blocked by partition"
             )
@@ -389,6 +402,11 @@ class Nemesis:
     between steps; ``done`` is set after the last step. Deterministic in
     the sense that matters: the *sequence* of fault states is fixed, and
     each link's fault draws come from its own seeded stream.
+
+    This runner is WALL-CLOCK (its own thread): it drives live threaded
+    clusters. The sim engine does not use it — it applies the same
+    NemesisStep schedules as virtual-time scheduler events instead
+    (babble_tpu.sim.scenario, docs/simulation.md).
     """
 
     def __init__(self, controller: ChaosController, steps: Sequence[NemesisStep]):

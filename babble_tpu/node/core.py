@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import time
 from typing import Callable, Dict, List, Optional
 
 from ..hashgraph.block import Block
@@ -71,7 +70,16 @@ class Core:
         accelerator_mesh: int = 0,
         mempool: Optional[Mempool] = None,
         sentry: Optional[Sentry] = None,
+        clock=None,
+        selector_rng=None,
     ):
+        # Time source (common/clock.py): event timestamps, leave-loop
+        # deadlines, selector backoff, and every telemetry duration below
+        # read through this handle. Default: the process wall clock; the
+        # sim engine injects virtual time (docs/simulation.md).
+        from ..common.clock import WALL
+
+        self.clock = clock if clock is not None else WALL
         # Gate the TPU batch-verify path behind a flag (the reference's
         # north-star `--accelerator` switch); jax is only imported when on.
         # Without the accelerator, incoming sync chunks still batch through
@@ -92,6 +100,8 @@ class Core:
         self.peer_selector = RandomPeerSelector(
             peers,
             validator.id(),
+            clock=self.clock.monotonic,
+            rng=selector_rng,
             quarantine_check=self.sentry.is_quarantined,
         )
         self.proxy_commit_callback = proxy_commit_callback
@@ -151,7 +161,7 @@ class Core:
             from ..hashgraph.accel import TensorConsensus
 
             self.accelerator_mesh = accelerator_mesh
-            self.hg.accel = TensorConsensus()
+            self.hg.accel = TensorConsensus(clock=self.clock)
 
         # Telemetry (docs/observability.md): the per-node registry wiring
         # every subsystem's counters into instruments, created at the
@@ -163,6 +173,9 @@ class Core:
         self.obs = NodeTelemetry(self)
         self._stage_obs = self.obs.stage_observer
         self.hg.stage_observer = self._stage_obs
+        # The @staged decorator times hashgraph stages against this
+        # clock, so simulated runs record virtual durations.
+        self.hg.stage_clock = self.clock.perf_counter
 
     # -- head/seq -----------------------------------------------------------
 
@@ -249,7 +262,7 @@ class Core:
         the run at next_pos. Shared by the lock-free prepare stage and
         sync's under-lock tail so their semantics can never diverge."""
         obs = self._stage_obs
-        t0 = time.perf_counter() if obs is not None else 0.0
+        t0 = self.clock.perf_counter() if obs is not None else 0.0
         overlay: Dict[tuple, str] = {}
         decoded: List[Event] = []
         j = start
@@ -268,7 +281,7 @@ class Core:
             decoded.append(ev)
             j += 1
         if obs is not None:
-            obs("decode", time.perf_counter() - t0)
+            obs("decode", self.clock.perf_counter() - t0)
         return decoded, j
 
     def _batch_prevalidate(self, decoded: List[Event]) -> None:
@@ -279,7 +292,7 @@ class Core:
         identified exactly (its verdict stays cached for insert to
         reject)."""
         obs = self._stage_obs
-        t_verify = time.perf_counter() if obs is not None else 0.0
+        t_verify = self.clock.perf_counter() if obs is not None else 0.0
         use_device_verify = self.accelerated_verify
         if use_device_verify:
             # Measured on the target: the device ladder kernel costs
@@ -310,7 +323,7 @@ class Core:
             if not prevalidate_events_host(decoded):
                 # Native library unavailable: scalar verify at insert.
                 if obs is not None:
-                    obs("batch_verify", time.perf_counter() - t_verify)
+                    obs("batch_verify", self.clock.perf_counter() - t_verify)
                 return
         self.ingest_batch_verifies += 1
         if len(decoded) > self.ingest_batch_size_max:
@@ -321,7 +334,7 @@ class Core:
                 ev.prevalidate(ev.verify())
                 self.ingest_fallback_singles += 1
         if obs is not None:
-            obs("batch_verify", time.perf_counter() - t_verify)
+            obs("batch_verify", self.clock.perf_counter() - t_verify)
 
     def sync(
         self,
@@ -454,7 +467,7 @@ class Core:
             return
 
         obs = self._stage_obs
-        t_event = time.perf_counter() if obs is not None else 0.0
+        t_event = self.clock.perf_counter() if obs is not None else 0.0
         sigs = list(self.self_block_signatures.values())
         n_itxs = len(self.internal_transaction_pool)
 
@@ -464,7 +477,7 @@ class Core:
         # keep busy() true and ride the next event (FIFO fairness).
         txs = self.mempool.drain()
         if obs is not None:
-            obs("mempool_drain", time.perf_counter() - t_event)
+            obs("mempool_drain", self.clock.perf_counter() - t_event)
 
         new_head = Event.new(
             txs,
@@ -473,7 +486,7 @@ class Core:
             [self.head, other_head],
             self.validator.public_key_bytes(),
             self.seq + 1,
-            timestamp=int(time.time()),
+            timestamp=int(self.clock.time()),
         )
 
         # Inserting can add items to the pools via the commit callback, so
@@ -492,7 +505,7 @@ class Core:
         if obs is not None:
             # whole self-event packaging incl. its insert+DivideRounds
             # (the nested insert/divide_rounds stages record too)
-            obs("self_event", time.perf_counter() - t_event)
+            obs("self_event", self.clock.perf_counter() - t_event)
 
     def sign_and_insert_self_event(self, event: Event) -> None:
         """reference: core.go:337-343."""
@@ -551,12 +564,12 @@ class Core:
         # replay to reach our join before concluding we have nothing to
         # do — capped below leave_timeout so a node that genuinely never
         # joined doesn't stall its shutdown for the whole timeout.
-        deadline = time.monotonic() + min(leave_timeout, 5.0)
+        deadline = self.clock.monotonic() + min(leave_timeout, 5.0)
         while True:
             p = self.validators.by_id.get(self.validator.id())
-            if p is not None or time.monotonic() > deadline:
+            if p is not None or self.clock.monotonic() > deadline:
                 break
-            time.sleep(0.05)
+            self.clock.sleep(0.05)
         if p is None or len(self.validators) <= 1:
             return
 
@@ -578,14 +591,14 @@ class Core:
         # Wait until consensus reaches the removed round
         # (reference: core.go:458-478).
         if len(self.peers) >= 1:
-            deadline = time.monotonic() + leave_timeout
+            deadline = self.clock.monotonic() + leave_timeout
             while (
                 self.hg.last_consensus_round is None
                 or self.hg.last_consensus_round < self.removed_round
             ):
-                if time.monotonic() > deadline:
+                if self.clock.monotonic() > deadline:
                     raise TimeoutError("timeout waiting to reach removed round")
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
 
     # -- commit -------------------------------------------------------------
 
@@ -596,11 +609,11 @@ class Core:
         if obs is None:
             commit_response = self.proxy_commit_callback(block)
         else:
-            t0 = time.perf_counter()
+            t0 = self.clock.perf_counter()
             try:
                 commit_response = self.proxy_commit_callback(block)
             finally:
-                obs("proxy_deliver", time.perf_counter() - t0)
+                obs("proxy_deliver", self.clock.perf_counter() - t0)
 
         # Feed the committed-hash LRU atomically with the commit (under
         # the mempool's own lock): from here on a client retry of any of

@@ -17,7 +17,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from typing import Dict, List, Optional
 
 from ..config.config import Config
@@ -66,9 +65,17 @@ class Node(StateManager):
         super().__init__()
         self.conf = conf
         self.logger = conf.logger("node")
+        # THE node's time source (common/clock.py): every deadline,
+        # sleep, and duration measurement below reads through this one
+        # handle, so the sim engine can swap in virtual time wholesale.
+        self.clock = conf.clock
         from ..mempool import Mempool
         from .sentry import Sentry
 
+        selector_rng = conf.seeded_rng("selector", validator.id())
+        # Jitter stream for the join/fast-forward retry backoffs below;
+        # None (production) lets backoff draw from the global random.
+        self._backoff_rng = conf.seeded_rng("backoff", validator.id())
         self.core = Core(
             validator,
             peers,
@@ -80,6 +87,8 @@ class Node(StateManager):
             accelerator_mesh=conf.accelerator_mesh,
             mempool=Mempool.from_config(conf),
             sentry=Sentry.from_config(conf),
+            clock=self.clock,
+            selector_rng=selector_rng,
         )
         # Equivocation proofs persist through the store's evidence table
         # (and load back on restart) when the store supports it.
@@ -91,7 +100,10 @@ class Node(StateManager):
         # Instrumented core lock: get_stats surfaces total acquisition
         # wait (lock_wait_ms_total) so lock-shrinking work stays measured;
         # contended waits also feed the core_lock_wait_seconds histogram.
-        self.core_lock = TimedLock(observer=self.telemetry.lock_wait_observer)
+        self.core_lock = TimedLock(
+            observer=self.telemetry.lock_wait_observer,
+            clock=self.clock.perf_counter,
+        )
         self.trans = trans
         self.proxy = proxy
         self.submit_q = proxy.submit_queue()
@@ -239,7 +251,7 @@ class Node(StateManager):
         """Main loop (reference: node.go:168-199)."""
         if self.conf.maintenance_mode:
             return
-        self.start_time = time.monotonic()
+        self.start_time = self.clock.monotonic()
         self.control_timer.run(self.conf.heartbeat_timeout)
         bg = threading.Thread(target=self._do_background_work, daemon=True)
         bg.start()
@@ -254,11 +266,11 @@ class Node(StateManager):
             elif state == State.JOINING:
                 self._join()
             elif state == State.SUSPENDED:
-                time.sleep(0.2)
+                self.clock.sleep(0.2)
             elif state == State.SHUTDOWN:
                 return
             else:
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
 
     def run_async(self, gossip: bool = True) -> None:
         t = threading.Thread(target=self.run, args=(gossip,), daemon=True)
@@ -347,6 +359,7 @@ class Node(StateManager):
         # offenders, lock_wait measures residual core-lock contention,
         # and the serialization-cache counters are process-wide (shared
         # by co-located nodes).
+        from ..crypto.batch import VERIFY_CACHE
         from ..crypto.canonical import NORM_CACHE
         from ..hashgraph.event import WIRE_CACHE
 
@@ -364,6 +377,8 @@ class Node(StateManager):
                 "wire_cache_misses": WIRE_CACHE.misses,
                 "norm_cache_hits": NORM_CACHE.hits,
                 "norm_cache_misses": NORM_CACHE.misses,
+                "verify_cache_hits": VERIFY_CACHE.hits,
+                "verify_cache_misses": VERIFY_CACHE.misses,
             }
         )
         # Mempool surface (docs/mempool.md): admission verdict counters,
@@ -551,9 +566,9 @@ class Node(StateManager):
         """SyncRequest leg (reference: node.go:504-538)."""
         with self.core_lock:
             known = self.core.known_events()
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
-        dt = time.monotonic() - t0
+        dt = self.clock.monotonic() - t0
         self.timers.record("request_sync", dt)
         self.telemetry.observe_stage("request_sync", dt)
         if len(resp.events) > self.conf.sync_limit:
@@ -562,22 +577,22 @@ class Node(StateManager):
             resp.events = resp.events[: self.conf.sync_limit]
             self.sync_limit_truncations += 1
             self.core.sentry.record(peer.id, "oversized_sync")
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         # Lock-free ingest stage: decode + hash + one batch signature
         # verification happen BEFORE the core lock; the lock then only
         # covers the ordered insert + DivideRounds sweep.
         prepared = self.core.prepare_sync(resp.events)
         with self.core_lock:
             self._sync(peer.id, resp.events, prepared)
-        self.timers.record("sync", time.monotonic() - t0)
+        self.timers.record("sync", self.clock.monotonic() - t0)
         return resp.known
 
     def _push(self, peer: Peer, known_events: Dict[int, int]) -> None:
         """EagerSyncRequest leg (reference: node.go:541-587)."""
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         with self.core_lock:
             diff = self.core.event_diff(known_events)
-        dt = time.monotonic() - t0
+        dt = self.clock.monotonic() - t0
         self.timers.record("diff", dt)
         self.telemetry.observe_stage("diff", dt)
         if not diff:
@@ -585,9 +600,9 @@ class Node(StateManager):
         if len(diff) > self.conf.sync_limit:
             diff = diff[: self.conf.sync_limit]
         wire = self.core.to_wire(diff)
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         self._request_eager_sync(peer.net_addr, wire)
-        dt = time.monotonic() - t0
+        dt = self.clock.monotonic() - t0
         self.timers.record("eager_sync", dt)
         self.telemetry.observe_stage("eager_sync", dt)
 
@@ -610,9 +625,9 @@ class Node(StateManager):
             # until after the batch's inserts complete, so the block
             # signatures those events carried must not sit unprocessed
             # behind the re-raise.
-            t0 = time.monotonic()
+            t0 = self.clock.monotonic()
             self.core.process_sig_pool()
-            dt = time.monotonic() - t0
+            dt = self.clock.monotonic() - t0
             self.timers.record("process_sig_pool", dt)
             self.telemetry.observe_stage("process_sig_pool", dt)
 
@@ -654,7 +669,7 @@ class Node(StateManager):
         which retrying can heal, re-poll."""
         from ..common.backoff import jittered_backoff
 
-        deadline = time.monotonic() + self.conf.fast_forward_deadline
+        deadline = self.clock.monotonic() + self.conf.fast_forward_deadline
         attempt = 0
         while True:
             best: Optional[FastForwardResponse] = None
@@ -678,13 +693,13 @@ class Node(StateManager):
             if best is not None or transport_errors == 0:
                 return best
             attempt += 1
-            delay = jittered_backoff(attempt, 0.1, 1.0)
+            delay = jittered_backoff(attempt, 0.1, 1.0, rng=self._backoff_rng)
             if (
-                time.monotonic() + delay > deadline
+                self.clock.monotonic() + delay > deadline
                 or self.shutdown_event.is_set()
             ):
                 return None
-            time.sleep(delay)
+            self.clock.sleep(delay)
 
     # -- joining ------------------------------------------------------------
 
@@ -695,7 +710,7 @@ class Node(StateManager):
         self.logger.info("JOINING")
         peer = self.core.peer_selector.next()
         if peer is None:
-            time.sleep(0.2)
+            self.clock.sleep(0.2)
             return
         try:
             resp = self._request_join(peer.net_addr)
@@ -704,14 +719,13 @@ class Node(StateManager):
             # feed the selector so the next attempt prefers another peer,
             # and back off exponentially (jittered, capped) — the run loop
             # re-enters _join, so the sleep here IS the retry cadence
-            from ..common.backoff import jittered_backoff
+            from ..common.backoff import backoff_sleep
 
             self.core.peer_selector.update_last(peer.id, False)
             self._join_failures += 1
-            time.sleep(
-                jittered_backoff(
-                    self._join_failures, 0.2, self.conf.join_backoff_cap
-                )
+            backoff_sleep(
+                self._join_failures, 0.2, self.conf.join_backoff_cap,
+                rng=self._backoff_rng, sleep=self.clock.sleep,
             )
             return
 
@@ -957,4 +971,7 @@ class Node(StateManager):
         return body
 
     def _log_stats(self) -> None:
-        self.logger.debug("stats: %s", self.get_stats())
+        # guard: get_stats() walks every subsystem (selector sweep,
+        # commit-latency summary) — don't build it just to drop the line
+        if self.logger.isEnabledFor(logging.DEBUG):
+            self.logger.debug("stats: %s", self.get_stats())

@@ -232,6 +232,10 @@ class Sentry:
             threshold=conf.sentry_threshold,
             quarantine_s=conf.sentry_quarantine_s,
             decay_halflife_s=conf.sentry_decay_halflife_s,
+            # the node clock: quarantine time-boxes and proof timestamps
+            # follow virtual time under the sim engine
+            clock=conf.clock.monotonic,
+            wall_clock=conf.clock.time,
         )
 
     # -- evidence persistence --------------------------------------------

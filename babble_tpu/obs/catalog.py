@@ -299,6 +299,14 @@ CATALOG: Tuple[Instrument, ...] = (
         "norm_cache_misses_total", _C, (), "global",
         "Canonical-JSON normalization cache misses (process-wide).",
     ),
+    Instrument(
+        "verify_cache_hits_total", _C, (), "global",
+        "Signature-verdict cache hits (process-wide).",
+    ),
+    Instrument(
+        "verify_cache_misses_total", _C, (), "global",
+        "Signature-verdict cache misses (process-wide).",
+    ),
 )
 
 BY_NAME: Dict[str, Instrument] = {i.name: i for i in CATALOG}

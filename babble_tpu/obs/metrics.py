@@ -498,4 +498,16 @@ def wire_global() -> None:
             "Process-wide canonical-JSON normalization cache misses.",
             lambda: NORM_CACHE.misses,
         )
+        from ..crypto.batch import VERIFY_CACHE
+
+        GLOBAL.func_counter(
+            "verify_cache_hits_total",
+            "Process-wide signature-verdict cache hits.",
+            lambda: VERIFY_CACHE.hits,
+        )
+        GLOBAL.func_counter(
+            "verify_cache_misses_total",
+            "Process-wide signature-verdict cache misses.",
+            lambda: VERIFY_CACHE.misses,
+        )
         _global_wired = True

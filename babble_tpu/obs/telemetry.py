@@ -49,6 +49,14 @@ class NodeTelemetry:
         wire_global()
         self._core = core
         self._node = None
+        # The node's time source: trace spans and stage durations are
+        # measured against it, so a simulated node's histograms hold
+        # virtual-time latencies instead of host-load noise (the
+        # wall-clock stamping bug this replaces made sim percentiles
+        # garbage). Cores predating the clock field fall back to wall.
+        from ..common.clock import WALL
+
+        self.clock = getattr(core, "clock", None) or WALL
 
         # -- hot instruments ------------------------------------------------
         self.commit_latency = self._histogram(
@@ -66,7 +74,10 @@ class NodeTelemetry:
         # Pre-resolved per-stage children so the hot path pays one dict
         # get, not a labels() call.
         self._stage_children: Dict[str, object] = {}
-        self.tracer = Tracer(stage_sink=self._observe_stage_hist)
+        self.tracer = Tracer(
+            stage_sink=self._observe_stage_hist,
+            clock=self.clock.perf_counter,
+        )
 
         # The observer the pipeline code null-checks: None when disabled
         # so instrumented code skips even its perf_counter reads.

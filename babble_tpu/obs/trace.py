@@ -27,9 +27,6 @@ import time
 from collections import deque
 from typing import Deque, List, Optional, Tuple
 
-_ids = itertools.count(1)
-
-
 def staged(stage: str):
     """Method decorator timing one pipeline stage against the owning
     object's ``stage_observer`` attribute. When the observer is None
@@ -42,11 +39,14 @@ def staged(stage: str):
             obs = self.stage_observer
             if obs is None:
                 return fn(self, *args, **kwargs)
-            t0 = time.perf_counter()
+            # the owner's injected stage clock, if any (Core wires the
+            # node Clock here so simulated stages record virtual time)
+            clk = getattr(self, "stage_clock", None) or time.perf_counter
+            t0 = clk()
             try:
                 return fn(self, *args, **kwargs)
             finally:
-                obs(stage, time.perf_counter() - t0)
+                obs(stage, clk() - t0)
 
         return wrapper
 
@@ -104,10 +104,13 @@ class SyncTrace:
     __slots__ = ("trace_id", "kind", "peer_id", "t0", "_agg", "_tracer")
 
     def __init__(self, tracer: "Tracer", kind: str, peer_id: int):
-        self.trace_id = next(_ids)
+        # ids and the clock come from the OWNING tracer (not process
+        # globals) so two identical simulated runs in one process produce
+        # identical trace records (docs/simulation.md determinism).
+        self.trace_id = next(tracer._ids)
         self.kind = kind
         self.peer_id = peer_id
-        self.t0 = time.perf_counter()
+        self.t0 = tracer.clock()
         # stage -> [count, total_seconds]; dicts preserve insertion order
         self._agg: dict = {}
         self._tracer = tracer
@@ -165,10 +168,16 @@ class Tracer:
     ``stage_sink`` is the telemetry callback feeding the
     ``sync_stage_seconds`` histogram children."""
 
-    def __init__(self, stage_sink=None, ring: int = 64):
+    def __init__(self, stage_sink=None, ring: int = 64,
+                 clock=time.perf_counter):
         self._local = threading.local()
         self._ring: Deque[dict] = deque(maxlen=ring)
         self.stage_sink = stage_sink
+        # per-tracer id stream + clock: deterministic under the sim
+        # engine's virtual time (module-global state would leak between
+        # runs in one process)
+        self._ids = itertools.count(1)
+        self.clock = clock
         self.traces_started = 0
         self.traces_finished = 0
 
@@ -193,7 +202,7 @@ class Tracer:
                 "kind": tr.kind,
                 "peer": tr.peer_id,
                 "total_ms": round(
-                    1e3 * (time.perf_counter() - tr.t0), 3
+                    1e3 * (self.clock() - tr.t0), 3
                 ),
                 "stages": [
                     [name, round(1e3 * s, 3)] for name, s in tr.stages

@@ -89,9 +89,26 @@ obssmoke:
 metricslint:
 	python -m babble_tpu.obs.lint docs/observability.md
 
+# simsmoke: deterministic virtual-time scenario sweep — 200 seeded
+# chaos x byzantine x churn x overload combinations with invariant
+# checks (no fork / liveness after heal / bounded queues / exactly-once
+# commit), in well under a minute of wall time (docs/simulation.md).
+# Asserts zero violations, then proves the failure path end-to-end: an
+# injected failing invariant must shrink to a minimal reproducer
+# artifact that replays byte-identically.
+simsmoke:
+	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --seeds 200 --out sim_artifacts | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['sim_scenarios'] >= 200, d; assert d['failed'] == 0, d; print('simsmoke ok:', d['sim_scenarios'], 'scenarios,', d['blocks_committed'], 'blocks,', str(d['speedup_virtual']) + 'x virtual speedup,', d['wall_s'], 's')"
+	rm -rf sim_artifacts_inject  # stale artifacts would break the ls-pick below after a generator change
+	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --seeds 1 --inject-failure --out sim_artifacts_inject | tail -n 1 | python -c "import json,sys,glob; d=json.loads(sys.stdin.read().strip()); assert d['failed'] == 1 and d['shrunk'] == 1 and d['artifacts'], d; print('shrink ok:', d['artifacts'][0])"
+	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --replay $$(ls sim_artifacts_inject/repro_*.json | head -n 1) | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['digests_match'] and d['violations'], d; print('replay ok: digests match')"
+
+# simsweep: the full thousands-of-seeds sweep (exploratory / nightly)
+simsweep:
+	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --seeds 2000 --out sim_artifacts
+
 # wheel: build the release wheel (native lib bundled+precompiled); the
 # analogue of the reference's scripts/dist.sh release build
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint simsmoke simsweep wheel

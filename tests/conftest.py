@@ -55,6 +55,12 @@ def pytest_configure(config):
         "tier-1 / make byzsmoke, the f=⌊(N−1)/3⌋ storm is also marked "
         "slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "sim: deterministic virtual-time simulation scenarios "
+        "(babble_tpu.sim, docs/simulation.md; the seeded sweep runs in "
+        "make simsmoke / simsweep)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,
