@@ -59,6 +59,11 @@ _RUN_FLAGS = {
     "sentry_threshold": ("sentry_threshold", float),
     "sentry_quarantine": ("sentry_quarantine_s", float),
     "sentry_decay_halflife": ("sentry_decay_halflife_s", float),
+    "trace_sample": ("trace_sample", float),
+    "trace_table_cap": ("trace_table_cap", int),
+    "watchdog_stall": ("watchdog_stall_s", float),
+    "watchdog_interval": ("watchdog_interval_s", float),
+    "flight_dir": ("flight_dir", str),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
@@ -320,6 +325,28 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--sentry-decay-halflife", dest="sentry_decay_halflife", type=float,
         default=None, help="misbehavior score decay half-life in seconds",
+    )
+    run.add_argument(
+        "--trace-sample", dest="trace_sample", type=float, default=None,
+        help="commit-provenance sampling rate (deterministic across "
+        "nodes; 1.0 traces every tx, 0 disables)",
+    )
+    run.add_argument(
+        "--trace-table-cap", dest="trace_table_cap", type=int,
+        default=None, help="max provenance records kept per node",
+    )
+    run.add_argument(
+        "--watchdog-stall", dest="watchdog_stall", type=float,
+        default=None,
+        help="stall seconds before the flight recorder trips (0 = off)",
+    )
+    run.add_argument(
+        "--watchdog-interval", dest="watchdog_interval", type=float,
+        default=None, help="stall-watchdog poll interval in seconds",
+    )
+    run.add_argument(
+        "--flight-dir", dest="flight_dir", default=None,
+        help="directory for flight-recorder artifacts",
     )
     run.add_argument(
         "--signal", action="store_true",

@@ -24,6 +24,15 @@ DEFAULT_SENTRY_THRESHOLD = 8.0
 DEFAULT_SENTRY_QUARANTINE_S = 30.0
 DEFAULT_SENTRY_DECAY_HALFLIFE_S = 30.0
 
+# Causal-tracing / flight-recorder defaults — single source of truth,
+# shared by the Config fields below, ProvenanceTable (obs/provenance.py)
+# and StallWatchdog (obs/flight.py) so standalone cores and bare
+# watchdogs can't drift from the configured tuning.
+DEFAULT_TRACE_SAMPLE = 1.0 / 64.0
+DEFAULT_TRACE_TABLE_CAP = 4096
+DEFAULT_WATCHDOG_STALL_S = 10.0
+DEFAULT_WATCHDOG_INTERVAL_S = 1.0
+
 
 def default_data_dir() -> str:
     """~/.babble equivalent (reference: config/config.go:287-297)."""
@@ -106,6 +115,21 @@ class Config:
     sentry_quarantine_s: float = DEFAULT_SENTRY_QUARANTINE_S
     sentry_decay_halflife_s: float = DEFAULT_SENTRY_DECAY_HALFLIFE_S
 
+    # Causal tracing + stall flight recorder (docs/observability.md
+    # §Causal tracing): trace_sample is the deterministic per-transaction
+    # sampling rate for the commit-provenance table (every node traces
+    # the SAME transactions; 1.0 = trace everything, 0 = off; env
+    # BABBLE_TRACE_SAMPLE overrides for a whole cluster at once);
+    # trace_table_cap bounds records per node. watchdog_stall_s is the
+    # no-progress-while-busy threshold that trips the flight recorder
+    # (0 disables); artifacts land in flight_dir (default:
+    # <tmpdir>/babble_tpu_flight). BABBLE_OBS=0 disables all of it.
+    trace_sample: float = DEFAULT_TRACE_SAMPLE
+    trace_table_cap: int = DEFAULT_TRACE_TABLE_CAP
+    watchdog_stall_s: float = DEFAULT_WATCHDOG_STALL_S
+    watchdog_interval_s: float = DEFAULT_WATCHDOG_INTERVAL_S
+    flight_dir: str = ""
+
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory
     database_dir: str = ""
@@ -137,6 +161,14 @@ class Config:
             from ..common.clock import WALL
 
             self.clock = WALL
+        # Cluster-wide sampling override without touching every node's
+        # flags/toml — sampling must agree across nodes for hop merges.
+        env_sample = os.environ.get("BABBLE_TRACE_SAMPLE")
+        if env_sample:
+            try:
+                self.trace_sample = float(env_sample)
+            except ValueError:
+                pass
         if not self.database_dir:
             self.database_dir = os.path.join(self.data_dir, DEFAULT_BADGER_DIR)
         # Option forcing (reference: babble/babble.go:133-143):

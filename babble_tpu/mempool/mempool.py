@@ -94,6 +94,9 @@ class Mempool:
         self._lat_commit = None
         self._lat_wait = None
         self._lat_consensus = None
+        # Commit-provenance table (attach_provenance): admit/drain
+        # stamps for the cross-node trace merge; None until attached.
+        self._prov = None
         self._admit_ts: Dict[bytes, float] = {}
         self._drain_ts: Dict[bytes, float] = {}
         self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()
@@ -151,6 +154,12 @@ class Mempool:
         self._lat_wait = tx_wait
         self._lat_consensus = tx_consensus
 
+    def attach_provenance(self, prov) -> None:
+        """Arm per-transaction commit provenance (obs/provenance.py):
+        sampled admissions and first drains get origin-side stamps. The
+        table applies its own sampling and no-ops when disabled."""
+        self._prov = prov
+
     # -- admission ----------------------------------------------------------
 
     def submit(self, tx: bytes) -> str:
@@ -193,6 +202,8 @@ class Mempool:
             self.accepted += 1
             if self._lat_commit is not None:
                 self._admit_ts[h] = self._clock()
+            if self._prov is not None:
+                self._prov.admit(tx)
             return ACCEPTED
 
     def submit_many(self, txs) -> List[str]:
@@ -225,6 +236,9 @@ class Mempool:
                     # matches commit_latency_seconds
                     self._drain_ts[h] = now
                     self._lat_wait.observe(now - ts)
+                if self._prov is not None:
+                    # provenance drain stamp (the table keeps the first)
+                    self._prov.drain(tx)
             while len(self._inflight) > self._inflight_cap:
                 aged_h, _ = self._inflight.popitem(last=False)
                 self._admit_ts.pop(aged_h, None)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Dict, Optional, Set, Tuple
 
 from .rpc import (
@@ -72,6 +73,7 @@ class InmemNetwork:
     def request(self, src: str, target: str, command, timeout: float = 5.0):
         t = self.route(src, target, timeout)
         rpc = RPC(command)
+        rpc.recv_ts = time.time()  # arrival stamp for trace attribution
         t.consumer().put(rpc)
         try:
             result, error = rpc.wait(timeout=timeout)

@@ -32,18 +32,29 @@ JOIN = 3
 @dataclass
 class SyncRequest:
     """Pull leg: ask a peer for events we don't know
-    (reference: net/commands.go:12-24)."""
+    (reference: net/commands.go:12-24).
+
+    ``trace`` is the optional wire trace context
+    (obs/provenance.py: {"id", "origin", "hop", "ts"-µs}). It rides the
+    payload only when present, so peers that predate the field — in
+    either direction — interoperate untouched: an old sender simply
+    omits it (``from_dict`` yields None, no trace is recorded), and an
+    old receiver ignores the unknown key."""
 
     from_id: int
     known: Dict[int, int]
     sync_limit: int
+    trace: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "from_id": self.from_id,
             "known": {str(k): v for k, v in self.known.items()},
             "sync_limit": self.sync_limit,
         }
+        if self.trace is not None:
+            d["trace"] = self.trace
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "SyncRequest":
@@ -51,6 +62,7 @@ class SyncRequest:
             from_id=d["from_id"],
             known={int(k): v for k, v in d["known"].items()},
             sync_limit=d["sync_limit"],
+            trace=d.get("trace"),
         )
 
 
@@ -83,22 +95,28 @@ class SyncResponse:
 @dataclass
 class EagerSyncRequest:
     """Push leg: send a peer the events they don't know
-    (reference: net/commands.go:34-40)."""
+    (reference: net/commands.go:34-40). ``trace`` as on SyncRequest —
+    optional on the wire, absent means no causal context."""
 
     from_id: int
     events: List[WireEvent] = field(default_factory=list)
+    trace: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "from_id": self.from_id,
             "events": [PreNormalized(e.normalized()) for e in self.events],
         }
+        if self.trace is not None:
+            d["trace"] = self.trace
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "EagerSyncRequest":
         return EagerSyncRequest(
             from_id=d["from_id"],
             events=[WireEvent.from_dict(e) for e in d["events"]],
+            trace=d.get("trace"),
         )
 
 
@@ -120,16 +138,22 @@ class EagerSyncResponse:
 @dataclass
 class FastForwardRequest:
     """Catch-up: request the anchor block + frame + app snapshot
-    (reference: net/commands.go:48-51)."""
+    (reference: net/commands.go:48-51). ``trace`` as on SyncRequest."""
 
     from_id: int
+    trace: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {"from_id": self.from_id}
+        d = {"from_id": self.from_id}
+        if self.trace is not None:
+            d["trace"] = self.trace
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "FastForwardRequest":
-        return FastForwardRequest(from_id=d["from_id"])
+        return FastForwardRequest(
+            from_id=d["from_id"], trace=d.get("trace")
+        )
 
 
 @dataclass
@@ -232,6 +256,11 @@ class RPC:
 
     def __init__(self, command):
         self.command = command
+        # Transport-arrival stamp (epoch seconds, the transport's clock):
+        # set by the server loops when they park the RPC on the consumer
+        # queue, so the handler can split "wire" from "queue" time in
+        # per-hop trace attribution. None when the transport predates it.
+        self.recv_ts: Optional[float] = None
         self._resp: "queue.Queue[Tuple[object, Optional[str]]]" = queue.Queue(1)
 
     def respond(self, result, error: Optional[str] = None) -> None:

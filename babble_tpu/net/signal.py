@@ -850,6 +850,7 @@ class SignalTransport:
             reply(None, f"malformed request body: {err}")
             return
         rpc = RPC(command)
+        rpc.recv_ts = time.time()  # arrival stamp (trace attribution)
         self._consumer.put(rpc)
         wait = (
             self._join_timeout + 2.0

@@ -21,6 +21,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..crypto.canonical import canonical_dumps
@@ -182,6 +183,7 @@ class TCPTransport:
                     continue
                 command = req_cls.from_dict(json.loads(payload))
                 rpc = RPC(command)
+                rpc.recv_ts = time.time()  # arrival stamp (trace attribution)
                 self._consumer.put(rpc)
                 # Joins park on a consensus promise in the handler; give the
                 # node's own join deadline room to fire first (+2 s margin).

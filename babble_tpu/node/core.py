@@ -341,6 +341,7 @@ class Core:
         from_id: int,
         unknown_events: List[WireEvent],
         prepared: Optional[PreparedSync] = None,
+        hop: Optional[dict] = None,
     ) -> None:
         """Insert wire events (topological order expected), track the other
         peer's head, and record a new self-event when busy
@@ -349,7 +350,13 @@ class Core:
         ``prepared`` is the lock-free stage's output for these SAME wire
         events (see prepare_sync); without it the stage runs inline here,
         preserving the one-batch-verify-per-sync property for direct
-        callers."""
+        callers.
+
+        ``hop`` is the carrying sync's causal-trace info
+        (``{"from", "ctx", "recv"}`` — the node handlers build it from
+        the RPC's trace context and arrival stamp); sampled transactions
+        in newly inserted events get a first-seen provenance record with
+        wire/queue/insert attribution (obs/provenance.py)."""
         self.ingest_syncs += 1
         if prepared is None:
             prepared = self.prepare_sync(unknown_events)
@@ -358,6 +365,13 @@ class Core:
             # prepared stage built from a different list would silently
             # mis-pair verified events with wire bookkeeping
             raise ValueError("prepared sync does not match wire events")
+        prov = self.obs.provenance
+        if prov is not None and prov.enabled and unknown_events:
+            hop = dict(hop) if hop is not None else {}
+            hop.setdefault("from", from_id)
+            hop["start"] = self.clock.time()
+        else:
+            hop = None
         other_head: Optional[Event] = None
         n = len(unknown_events)
         # Equivocations are skip-and-collect, not abort: a fork-holding
@@ -370,7 +384,9 @@ class Core:
 
         pos = len(prepared.decoded)
         for we, ev in zip(unknown_events[:pos], prepared.decoded):
-            other_head = self._ingest_one(we, ev, from_id, other_head, fork_errs)
+            other_head = self._ingest_one(
+                we, ev, from_id, other_head, fork_errs, hop
+            )
 
         while pos < n:
             # Tail after a decode stall: re-run decode+batch-verify in
@@ -390,7 +406,9 @@ class Core:
                 j = pos + 1
 
             for we, ev in zip(unknown_events[pos:j], decoded):
-                other_head = self._ingest_one(we, ev, from_id, other_head, fork_errs)
+                other_head = self._ingest_one(
+                    we, ev, from_id, other_head, fork_errs, hop
+                )
             pos = j
 
         # Do not overwrite a non-empty head with an empty one
@@ -422,6 +440,7 @@ class Core:
         from_id: int,
         other_head: Optional[Event],
         fork_errs: Optional[List[ForkError]] = None,
+        hop: Optional[dict] = None,
     ) -> Optional[Event]:
         """Insert one decoded sync event and maintain the heads-merge
         bookkeeping; returns the updated other-peer head. A ForkError is
@@ -439,6 +458,14 @@ class Core:
                 # Benign concurrent-duplicate-insert race.
                 return other_head
             raise
+
+        if hop is not None and ev.body.transactions:
+            # first local sight of this event's transactions: stamp the
+            # sampled ones with per-hop attribution (duplicate inserts
+            # never reach here — they raise above)
+            self.obs.provenance.first_seen_batch(
+                ev.body.transactions, hop
+            )
 
         if we.body.creator_id == from_id:
             other_head = ev
@@ -621,6 +648,15 @@ class Core:
         # (same tx submitted to several nodes, committed via another's
         # event) are dropped before they can double-commit.
         self.mempool.mark_committed(block.transactions())
+
+        # Provenance: close the sampled transactions' records with the
+        # commit stamp + block coordinates (every node stamps its own
+        # commit; traceview merges the spread).
+        prov = self.obs.provenance
+        if prov is not None and prov.enabled and block.transactions():
+            prov.commit_batch(
+                block.transactions(), block.index(), block.round_received()
+            )
 
         block.body.state_hash = commit_response.state_hash
         block.body.internal_transaction_receipts = commit_response.receipts

@@ -36,6 +36,7 @@ from ..net.rpc import (
     SyncResponse,
 )
 from ..net.transport import RemoteError, Transport, TransportError
+from ..obs.provenance import parse_ctx
 from ..peers.peer import Peer
 from ..peers.peer_set import PeerSet
 from ..common.latency import LatencyRecorder
@@ -138,6 +139,27 @@ class Node(StateManager):
         # fault counter the chaos soaks assert on (rpc_errors_* counts
         # handler crashes, this counts the wire).
         self.gossip_transport_errors = 0
+        # Causal tracing (docs/observability.md §Causal tracing):
+        # inbound RPCs carrying a wire trace context, and the last
+        # SUCCESSFUL outbound gossip round (node clock, monotonic) — the
+        # stall watchdog's gossip-liveness signal.
+        self.trace_ctx_rpcs = 0
+        self.last_gossip_ok: Optional[float] = None
+        # Provenance knobs ride the Config; the table itself was built
+        # by the core's NodeTelemetry (so standalone cores trace too).
+        self.telemetry.provenance.configure(
+            sample=conf.trace_sample, cap=conf.trace_table_cap
+        )
+        # Stall flight recorder (obs/flight.py): armed by run(), fired
+        # when a busy node stops making consensus progress.
+        from ..obs.flight import StallWatchdog
+
+        self.watchdog = StallWatchdog(
+            self,
+            stall_s=conf.watchdog_stall_s,
+            interval_s=conf.watchdog_interval_s,
+            out_dir=conf.flight_dir,
+        )
         # Joining-state backoff: consecutive join failures grow the retry
         # sleep exponentially (capped by conf.join_backoff_cap) so a node
         # stuck outside a partitioned cluster doesn't hammer dead peers.
@@ -252,6 +274,11 @@ class Node(StateManager):
         if self.conf.maintenance_mode:
             return
         self.start_time = self.clock.monotonic()
+        if self.telemetry.enabled:
+            # flight recorder (no-op when watchdog_stall_s <= 0); only
+            # the threaded production path arms the monitor — the sim
+            # harness drives nodes without run() and calls check() itself
+            self.watchdog.start()
         self.control_timer.run(self.conf.heartbeat_timeout)
         bg = threading.Thread(target=self._do_background_work, daemon=True)
         bg.start()
@@ -292,6 +319,7 @@ class Node(StateManager):
             self.logger.info("SHUTDOWN")
             self._transition(State.SHUTDOWN)
             self.shutdown_event.set()
+            self.watchdog.stop()
             self.control_timer.shutdown()
             self.wait_routines(timeout=2.0)
             if self.trans is not None:
@@ -397,6 +425,15 @@ class Node(StateManager):
             {f"rpc_errors_{k}": v for k, v in self.rpc_errors.items()}
         )
         stats["gossip_transport_errors"] = self.gossip_transport_errors
+        # Causal-tracing / flight-recorder surface
+        # (docs/observability.md §Causal tracing)
+        stats["trace_ctx_rpcs"] = self.trace_ctx_rpcs
+        prov = self.telemetry.provenance.stats()
+        stats["trace_sampled_txs"] = prov["sampled_total"]
+        stats["trace_provenance_entries"] = prov["entries"]
+        stats["trace_provenance_evictions"] = prov["evictions"]
+        stats["watchdog_trips"] = self.watchdog.trips
+        stats["flight_dumps"] = self.watchdog.dumps
         stats.update(self.core.peer_selector.stats())
         stats["sync_limit_truncations"] = self.sync_limit_truncations
         stats.update(self.core.sentry.stats())
@@ -538,6 +575,7 @@ class Node(StateManager):
             other_known = self._pull(peer)
             self._push(peer, other_known)
             connected = True
+            self.last_gossip_ok = self.clock.monotonic()
             self._log_stats()
         except TransportError as err:
             transport_failure = True
@@ -568,6 +606,10 @@ class Node(StateManager):
             known = self.core.known_events()
         t0 = self.clock.monotonic()
         resp = self._request_sync(peer.net_addr, known, self.conf.sync_limit)
+        # response arrival: the pulled events' "recv" stamp for per-hop
+        # trace attribution (no wire ctx on a pull — the latency is OUR
+        # request_sync round-trip, not a remote push)
+        recv = self.clock.time() if self.telemetry.enabled else None
         dt = self.clock.monotonic() - t0
         self.timers.record("request_sync", dt)
         self.telemetry.observe_stage("request_sync", dt)
@@ -583,7 +625,10 @@ class Node(StateManager):
         # covers the ordered insert + DivideRounds sweep.
         prepared = self.core.prepare_sync(resp.events)
         with self.core_lock:
-            self._sync(peer.id, resp.events, prepared)
+            self._sync(
+                peer.id, resp.events, prepared,
+                hop={"from": peer.id, "recv": recv},
+            )
         self.timers.record("sync", self.clock.monotonic() - t0)
         return resp.known
 
@@ -611,12 +656,14 @@ class Node(StateManager):
         from_id: int,
         events: List[WireEvent],
         prepared: Optional[PreparedSync] = None,
+        hop: Optional[dict] = None,
     ) -> None:
         """Insert events + process the sig pool; callers hold core_lock
         and SHOULD pass the prepare_sync output computed outside it
-        (reference: node.go:591-615)."""
+        (reference: node.go:591-615). ``hop`` is the carrying sync's
+        causal-trace info for per-transaction provenance (Core.sync)."""
         try:
-            self.core.sync(from_id, events, prepared)
+            self.core.sync(from_id, events, prepared, hop)
         except Exception as err:
             if not is_normal_self_parent_error(err):
                 raise
@@ -745,16 +792,31 @@ class Node(StateManager):
         self, target: str, known: Dict[int, int], sync_limit: int
     ) -> SyncResponse:
         return self.trans.sync(
-            target, SyncRequest(self.get_id(), known, sync_limit)
+            target,
+            SyncRequest(
+                self.get_id(), known, sync_limit,
+                trace=self.telemetry.wire_ctx(self.get_id()),
+            ),
         )
 
     def _request_eager_sync(
         self, target: str, events: List[WireEvent]
     ) -> EagerSyncResponse:
-        return self.trans.eager_sync(target, EagerSyncRequest(self.get_id(), events))
+        return self.trans.eager_sync(
+            target,
+            EagerSyncRequest(
+                self.get_id(), events,
+                trace=self.telemetry.wire_ctx(self.get_id()),
+            ),
+        )
 
     def _request_fast_forward(self, target: str) -> FastForwardResponse:
-        return self.trans.fast_forward(target, FastForwardRequest(self.get_id()))
+        return self.trans.fast_forward(
+            target,
+            FastForwardRequest(
+                self.get_id(), trace=self.telemetry.wire_ctx(self.get_id())
+            ),
+        )
 
     def _request_join(self, target: str) -> JoinResponse:
         join_tx = InternalTransaction.join(
@@ -781,6 +843,10 @@ class Node(StateManager):
             return
 
         cmd = rpc.command
+        if getattr(cmd, "trace", None) is not None:
+            # wire trace context present (absent from old peers — both
+            # directions interoperate, docs/observability.md)
+            self.trace_ctx_rpcs += 1
         # Quarantined peers get no sync service: their pushes are the
         # attack surface and their pulls only help them keep up. Join and
         # fast-forward stay open (different identity/recovery paths).
@@ -844,9 +910,20 @@ class Node(StateManager):
         try:
             # Same lock-shrink as _pull: the batch decode+verify stage
             # runs before the lock, the lock covers only the inserts.
+            hop = None
+            if self.telemetry.enabled:
+                hop = {
+                    "from": cmd.from_id,
+                    "ctx": parse_ctx(cmd.trace),
+                    # transport arrival when stamped; else handler entry
+                    "recv": (
+                        rpc.recv_ts if rpc.recv_ts is not None
+                        else self.clock.time()
+                    ),
+                }
             prepared = self.core.prepare_sync(cmd.events)
             with self.core_lock:
-                self._sync(cmd.from_id, cmd.events, prepared)
+                self._sync(cmd.from_id, cmd.events, prepared, hop)
         except Exception as e:
             success = False
             cause = self.core.sentry.observe_rejection(e, cmd.from_id)
@@ -955,6 +1032,27 @@ class Node(StateManager):
         return {
             "config": self.core.mempool.config(),
             "stats": self.core.mempool.stats(),
+        }
+
+    def get_trace(self, txid: str) -> Optional[Dict[str, object]]:
+        """/trace/<txid> service payload: THIS node's provenance record
+        for one transaction (None → 404; obs/traceview.py merges several
+        nodes' answers into the cross-node timeline)."""
+        rec = self.telemetry.provenance.get(txid)
+        if rec is None:
+            return None
+        rec["node"] = self.get_id()
+        rec["moniker"] = self.core.validator.moniker
+        return rec
+
+    def get_traces(self, limit: int = 256) -> Dict[str, object]:
+        """/traces service payload: bulk provenance export (newest-last,
+        bounded) plus the table's own stats."""
+        return {
+            "node": self.get_id(),
+            "moniker": self.core.validator.moniker,
+            "provenance": self.telemetry.provenance.stats(),
+            "records": self.telemetry.provenance.export(limit=limit),
         }
 
     def get_suspects(self) -> Dict[str, object]:

@@ -179,6 +179,35 @@ CATALOG: Tuple[Instrument, ...] = (
         "mempool_inflight_aged_total", _C, (), "node",
         "In-flight hashes aged out past the dedup cap.",
     ),
+    # -- causal tracing / flight recorder ----------------------------------
+    Instrument(
+        "trace_sampled_txs_total", _C, (), "node",
+        "Transactions sampled into the commit-provenance table "
+        "(deterministic cross-node sampling, docs/observability.md "
+        "§Causal tracing).",
+    ),
+    Instrument(
+        "trace_provenance_entries", _G, (), "node",
+        "Live commit-provenance records (bounded table, oldest evicted).",
+    ),
+    Instrument(
+        "trace_provenance_evictions_total", _C, (), "node",
+        "Provenance records evicted past the table cap.",
+    ),
+    Instrument(
+        "trace_ctx_rpcs_total", _C, (), "node",
+        "Inbound Sync/EagerSync/FastForward RPCs that carried a wire "
+        "trace context.",
+    ),
+    Instrument(
+        "watchdog_trips_total", _C, (), "node",
+        "Stall-watchdog trips (busy node, no consensus progress past "
+        "the threshold).",
+    ),
+    Instrument(
+        "flight_dumps_total", _C, (), "node",
+        "Flight-recorder artifacts written (bounded per node).",
+    ),
     # -- peer selector / gossip health -------------------------------------
     Instrument(
         "selector_unhealthy_peers", _G, (), "node",

@@ -39,6 +39,7 @@ from .metrics import (
     enabled as obs_enabled,
     wire_global,
 )
+from .provenance import ProvenanceTable
 from .trace import NULL_TRACE, Tracer
 
 
@@ -77,6 +78,14 @@ class NodeTelemetry:
         self.tracer = Tracer(
             stage_sink=self._observe_stage_hist,
             clock=self.clock.perf_counter,
+        )
+        # Per-transaction commit provenance (docs/observability.md
+        # §"Causal tracing"): admit/drain/first-seen/commit stamps keyed
+        # by tx hash, deterministically sampled so every node traces the
+        # same transactions. Node.__init__ applies the Config knobs via
+        # provenance.configure(); standalone cores keep the defaults.
+        self.provenance = ProvenanceTable(
+            clock=self.clock, enabled=self.enabled
         )
 
         # The observer the pipeline code null-checks: None when disabled
@@ -124,6 +133,26 @@ class NodeTelemetry:
         if not self.enabled:
             return NULL_TRACE
         return self.tracer.start(kind, peer_id)
+
+    def wire_ctx(self, node_id: int):
+        """Trace context for an outbound Sync/EagerSync/FastForward RPC
+        (obs/provenance.py wire format), tagged with the active gossip
+        span's id so the receiver's records join this round. None when
+        telemetry is disabled — the wire field is simply omitted.
+
+        Built inline (not via make_ctx): this runs once per outbound
+        gossip RPC, and the ids are short by construction so the
+        hostile-length clamp is the receiver's job (parse_ctx)."""
+        if not self.enabled:
+            return None
+        tr = self.tracer.active()
+        tid = tr.trace_id if tr is not None else next(self.tracer._ids)
+        return {
+            "id": f"{node_id:x}-{tid}",
+            "origin": node_id,
+            "hop": 0,
+            "ts": int(self.clock.time() * 1e6),
+        }
 
     # -- wiring -------------------------------------------------------------
 
@@ -174,6 +203,18 @@ class NodeTelemetry:
                 self._tx_stage.labels(stage="mempool_wait"),
                 self._tx_stage.labels(stage="consensus"),
             )
+            m.attach_provenance(self.provenance)
+        self._func(
+            "trace_sampled_txs_total",
+            lambda: self.provenance.sampled_total,
+        )
+        self._func(
+            "trace_provenance_entries", lambda: len(self.provenance)
+        )
+        self._func(
+            "trace_provenance_evictions_total",
+            lambda: self.provenance.evictions,
+        )
         self._func("mempool_pending", lambda: m.pending_count)
         self._func("mempool_pending_bytes", lambda: m.pending_bytes)
         self._func("mempool_inflight", lambda: len(m._inflight))
@@ -313,6 +354,17 @@ class NodeTelemetry:
         self._func(
             "core_lock_acquisitions_total",
             lambda: node.core_lock.acquisitions,
+        )
+        self._func(
+            "trace_ctx_rpcs_total", lambda: node.trace_ctx_rpcs
+        )
+        self._func(
+            "watchdog_trips_total",
+            lambda: getattr(node.watchdog, "trips", 0),
+        )
+        self._func(
+            "flight_dumps_total",
+            lambda: getattr(node.watchdog, "dumps", 0),
         )
 
     # -- views --------------------------------------------------------------

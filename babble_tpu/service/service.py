@@ -90,6 +90,19 @@ class Service:
                 # sentry misbehavior ledger + equivocation proofs
                 # (docs/robustness.md §Byzantine fault model)
                 body = self.node.get_suspects()
+            elif path.startswith("/trace/"):
+                # one transaction's local provenance record (cross-node
+                # merge: python -m babble_tpu.obs.traceview)
+                body = self.node.get_trace(path[len("/trace/"):])
+                if body is None:
+                    self._send(req, 404, {"error": "unknown txid"})
+                    return
+            elif path == "/traces":
+                # bulk provenance export (?limit=N, newest-last)
+                qs = parse_qs(parsed.query)
+                body = self.node.get_traces(
+                    limit=int(qs.get("limit", ["256"])[0])
+                )
             elif path.startswith("/block/"):
                 body = _jsonable(
                     self.node.get_block(int(path[len("/block/"):])).to_dict()

@@ -151,6 +151,7 @@ class SimCluster:
         sync_limit: int = 256,
         mempool_max_txs: int = 512,
         split: bool = False,
+        trace_sample: Optional[float] = None,
     ):
         self.sch = sch
         self.network = SimNetwork()
@@ -175,6 +176,12 @@ class SimCluster:
         self.n_honest = n_honest
 
         def conf(i: int) -> Config:
+            kw = {}
+            if trace_sample is not None:
+                # provenance sampling override (the determinism tests
+                # trace every tx; stamps ride the SimClock, so same-seed
+                # runs export byte-identical provenance)
+                kw["trace_sample"] = trace_sample
             return Config(
                 heartbeat_timeout=heartbeat_s,
                 slow_heartbeat_timeout=4 * heartbeat_s,
@@ -185,6 +192,7 @@ class SimCluster:
                 mempool_max_txs=mempool_max_txs,
                 clock=sch.clock,
                 sim_seed=sch.seed,
+                **kw,
             )
 
         self.nodes: List[Node] = []
@@ -327,3 +335,21 @@ class SimCluster:
     def commit_digests(self) -> Dict[str, str]:
         return {f"node{i}": self.commit_digest(i)
                 for i in range(self.n_honest)}
+
+    def provenance_exports(self) -> List[dict]:
+        """Every honest node's /traces-shaped provenance export — the
+        input obs.traceview.merge_all consumes, identical to what a live
+        cluster serves over HTTP."""
+        return [n.get_traces(limit=-1) for n in self.nodes]
+
+    def provenance_digest(self) -> str:
+        """sha256 over every honest node's provenance export (stamps are
+        SimClock time, ids are per-node tracer counters — byte-identical
+        across same-seed runs; docs/simulation.md)."""
+        import json as _json
+
+        payload = _json.dumps(
+            self.provenance_exports(), sort_keys=True,
+            separators=(",", ":"), default=str,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()

@@ -16,6 +16,14 @@ Prometheus endpoint, docs/observability.md) is scraped after the
 bombardment and its commit-latency p50/p90/p99 printed — the quickest
 way to see the north-star latency of a live testnet.
 
+With ``--trace=K`` (requires ``--metrics`` for the service addresses),
+up to K of the submitted transactions that fall inside the cluster's
+deterministic provenance sample (``--trace-sample`` must match the
+nodes' ``trace_sample``; default 1/64) have their ``/trace/<txid>``
+records fetched from every listed node after the commit settle, merged
+into cross-node timelines (obs/traceview.py), and the per-hop
+wire/queue/insert/consensus p50/p99 attribution printed at exit.
+
 Byzantine mode — drive the adversary harness (babble_tpu.adversary)
 against a live cluster outside pytest: point it at a compromised
 validator's datadir (priv_key + peers.json — stop that node first, the
@@ -88,8 +96,66 @@ def scrape_commit_latency(endpoints: str, settle_s: float = 15.0) -> None:
         )
 
 
-def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> None:
-    """Submit one tx, backing off and retrying on overload verdicts."""
+def trace_attribution(endpoints: str, accepted_txs: list, k: int,
+                      sample: float, settle_s: float = 15.0) -> None:
+    """Fetch provenance for up to ``k`` sampled accepted transactions
+    from every service endpoint, merge cross-node, and print per-hop
+    latency attribution (docs/observability.md §Causal tracing)."""
+    import hashlib
+
+    from babble_tpu.obs import traceview
+    from babble_tpu.obs.provenance import sample_inverse, tx_sampled
+
+    inv = sample_inverse(sample)
+    picked = [tx for tx in accepted_txs if tx_sampled(tx, inv)][:k]
+    if not picked:
+        print(
+            "trace: none of the accepted txs fall in the sample "
+            f"(sample={sample}); raise --trace-sample on the nodes",
+            file=sys.stderr,
+        )
+        return
+    eps = [ep.strip() for ep in endpoints.split(",") if ep.strip()]
+    merged = []
+    deadline = time.monotonic() + settle_s
+    for tx in picked:
+        txid = hashlib.sha256(tx).hexdigest()
+        while True:
+            exports = []
+            for ep in eps:
+                try:
+                    exp = traceview.fetch_node(ep, txid=txid)
+                except Exception as err:  # noqa: BLE001 — skip dead nodes
+                    print(f"{ep}: trace scrape failed ({err})",
+                          file=sys.stderr)
+                    continue
+                if exp is not None:
+                    exports.append(exp)
+            m = traceview.merge_tx(txid, exports)
+            # commits lag the final submit: re-poll an uncommitted trace
+            if (m is not None and m["committed_on"]) or (
+                time.monotonic() >= deadline
+            ):
+                break
+            time.sleep(0.5)
+        if m is None:
+            print(f"trace: {txid[:16]}… not found on any node")
+            continue
+        merged.append(m)
+        print(traceview.render(m))
+    if merged:
+        print(f"\ntrace attribution over {len(merged)} tx(s):")
+        for stage, s in traceview.attribution_summary(merged).items():
+            if s["n"]:
+                print(
+                    f"  {stage:<12} n={s['n']:<5} p50={s['p50_ms']}ms "
+                    f"p99={s['p99_ms']}ms"
+                )
+
+
+def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> str:
+    """Submit one tx, backing off and retrying on overload verdicts;
+    returns the final verdict."""
     attempt = 0
     while True:
         result = client.call(
@@ -104,7 +170,7 @@ def submit_with_backoff(client: JsonRpcClient, tx: bytes, counts: dict) -> None:
         if verdict in ("throttled", "full"):
             counts["shed"] += 1
         counts[verdict] = counts.get(verdict, 0) + 1
-        return
+        return verdict
 
 
 def run_byzantine(
@@ -178,11 +244,13 @@ def main() -> int:
 
     counts: dict = {"shed": 0, "backoffs": 0}
     sent = 0
+    accepted_txs: list = []
     for i in range(n):
         client = JsonRpcClient(f"127.0.0.1:{base_port + i}")
         for j in range(m):
             tx = f"node{i} tx {j}".encode()
-            submit_with_backoff(client, tx, counts)
+            if submit_with_backoff(client, tx, counts) == "accepted":
+                accepted_txs.append(tx)
             sent += 1
         client.close()
         print(f"node{i}: {m} txs submitted")
@@ -200,6 +268,18 @@ def main() -> int:
         print(f"shed rate: {counts['shed'] / sent:.3f}")
     if "metrics" in opts:
         scrape_commit_latency(opts["metrics"])
+    if "trace" in opts:
+        if "metrics" not in opts:
+            print("--trace needs --metrics=host:port,... for the service "
+                  "addresses", file=sys.stderr)
+            return 2
+        from babble_tpu.obs.provenance import DEFAULT_SAMPLE
+
+        trace_attribution(
+            opts["metrics"], accepted_txs,
+            k=int(opts.get("trace") or 8),
+            sample=float(opts.get("trace-sample", DEFAULT_SAMPLE)),
+        )
     return 0
 
 

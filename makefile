@@ -89,6 +89,15 @@ obssmoke:
 metricslint:
 	python -m babble_tpu.obs.lint docs/observability.md
 
+# tracesmoke: cross-node causal tracing end to end — a live 4-node TCP
+# cluster with HTTP services, every tx sampled; asserts a committed
+# transaction's /trace/<txid> records merge (traceview) into a timeline
+# with >= 2 gossip hops and monotone stamps, per-hop wire/queue/insert/
+# consensus attribution present, plus the wire backward-compat and
+# flight-recorder paths (docs/observability.md §Causal tracing)
+tracesmoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m "not slow"
+
 # simsmoke: deterministic virtual-time scenario sweep — 200 seeded
 # chaos x byzantine x churn x overload combinations with invariant
 # checks (no fork / liveness after heal / bounded queues / exactly-once
@@ -111,4 +120,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint tracesmoke simsmoke simsweep wheel

@@ -61,6 +61,11 @@ def pytest_configure(config):
         "(babble_tpu.sim, docs/simulation.md; the seeded sweep runs in "
         "make simsmoke / simsweep)",
     )
+    config.addinivalue_line(
+        "markers",
+        "trace: cross-node causal-tracing smokes (live cluster + "
+        "/trace endpoints + traceview merge; make tracesmoke)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,

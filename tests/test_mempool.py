@@ -341,8 +341,17 @@ def test_overload_soak_bounded_and_exactly_once():
     """Submit rate ≫ commit rate against a small admission cap: pending
     never exceeds the cap, a nonzero share is shed, and every ACCEPTED
     transaction commits exactly once on every node (no loss, no
-    duplicate commit)."""
+    duplicate commit).
+
+    The flood is scaled to the HOST's throughput instead of a fixed
+    3000-tx multiplier (the ISSUE-7 flake): a fast host drained the
+    fixed flood before shedding engaged, so the loop keeps submitting
+    unique txs until `full` has fired several times — which a tight
+    submit loop always reaches long before a 3-node in-process cluster
+    can commit the generous upper bound."""
     cap = 256
+    target_sheds = 10
+    max_flood = 50000
     nodes, proxies, states = _make_cluster(3, mempool_max_txs=cap)
     try:
         for n in nodes:
@@ -350,10 +359,10 @@ def test_overload_soak_bounded_and_exactly_once():
         accepted: List[bytes] = []
         verdicts = {"accepted": 0, "full": 0, "other": 0}
         pending_max = 0
-        # ~3000 unique txs pushed as fast as the loop can go — far faster
-        # than a 3-node in-process cluster commits
-        for i in range(3000):
+        i = 0
+        while i < max_flood and verdicts["full"] < target_sheds:
             tx = f"soak tx {i}".encode()
+            i += 1
             v = proxies[0].submit_tx(tx)
             if v == ACCEPTED:
                 accepted.append(tx)
@@ -365,14 +374,18 @@ def test_overload_soak_bounded_and_exactly_once():
             pending = nodes[0].core.mempool.pending_count
             pending_max = max(pending_max, pending)
         assert pending_max <= cap, f"pending {pending_max} exceeded cap {cap}"
-        assert verdicts["full"] > 0, f"no shedding under overload: {verdicts}"
+        assert verdicts["full"] >= target_sheds, (
+            f"no shedding after {i} txs: {verdicts}"
+        )
         assert verdicts["accepted"] >= cap  # cap itself plus drain headroom
 
-        # drain phase: every accepted tx must commit (exactly once)
+        # drain phase: every accepted tx must commit (exactly once) on
+        # EVERY node — the wait covers all of them, so the per-node
+        # assertions below can't race the last node's commit lag
         deadline = time.monotonic() + 120
         want = set(accepted)
         while time.monotonic() < deadline:
-            if want.issubset(set(states[0].committed_txs)):
+            if all(want.issubset(set(st.committed_txs)) for st in states):
                 break
             time.sleep(0.05)
         committed = states[0].committed_txs

@@ -1,0 +1,482 @@
+"""Cross-node causal tracing + stall flight recorder (ISSUE-8,
+docs/observability.md §Causal tracing): provenance-table units,
+deterministic sampling, wire trace-context codec + backward compat
+(both directions), a live 4-node TCP cluster whose committed
+transactions merge into multi-hop timelines over HTTP (`make
+tracesmoke`), the traceview merge/attribution tool, and the stall
+watchdog's flight-recorder artifact."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import List
+
+import pytest
+
+from babble_tpu.config.config import Config
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.net.rpc import (
+    EagerSyncRequest,
+    FastForwardRequest,
+    SyncRequest,
+)
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.obs import traceview
+from babble_tpu.obs.flight import StallWatchdog
+from babble_tpu.obs.provenance import (
+    DEFAULT_SAMPLE,
+    ProvenanceTable,
+    make_ctx,
+    parse_ctx,
+    sample_inverse,
+    tx_sampled,
+)
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+
+def _txid(tx: bytes) -> str:
+    return hashlib.sha256(tx).hexdigest()
+
+
+# -- unit: sampling + table lifecycle ---------------------------------------
+
+
+def test_sampling_is_deterministic_and_roughly_calibrated():
+    inv = sample_inverse(DEFAULT_SAMPLE)
+    assert inv == 64
+    txs = [f"tx {i}".encode() for i in range(20000)]
+    first = [tx_sampled(t, inv) for t in txs]
+    assert first == [tx_sampled(t, inv) for t in txs]  # pure function
+    rate = sum(first) / len(first)
+    assert 0.005 < rate < 0.05, rate  # ~1/64 ± noise
+    # boundary rates
+    assert sample_inverse(0.0) == 0 and not tx_sampled(b"x", 0)
+    assert sample_inverse(1.0) == 1 and tx_sampled(b"x", 1)
+
+
+def test_provenance_lifecycle_and_bounds():
+    t = ProvenanceTable(sample=1.0, cap=8)
+    tx = b"the tx"
+    t.admit(tx)
+    t.drain(tx)
+    t.drain(tx)  # requeue-style second drain: first stamp wins
+    first_drain = t.get(_txid(tx))["drain"]
+    t.commit_batch([tx], block_index=4, round_received=9)
+    rec = t.get(_txid(tx))
+    assert rec["admit"] <= rec["drain"] <= rec["commit"]
+    assert rec["drain"] == first_drain
+    assert rec["block"] == 4 and rec["round_received"] == 9
+    # a remote-side record via first_seen, with hop attribution
+    ctx = make_ctx("a-1", origin=7, ts_s=t._clock.time() - 0.002)
+    t.first_seen_batch(
+        [b"remote tx"],
+        {"from": 7, "ctx": parse_ctx(ctx),
+         "recv": t._clock.time() - 0.001, "start": t._clock.time()},
+    )
+    rrec = t.get(_txid(b"remote tx"))
+    assert rrec["hop"] == 1 and rrec["from"] == 7 and rrec["ctx"] == "a-1"
+    assert rrec["wire_s"] >= 0 and rrec["queue_s"] >= 0
+    assert rrec["insert_s"] >= 0
+    # a locally-drained tx never becomes a "hop" on its own node
+    t.first_seen_batch([tx], {"from": 3})
+    assert "first_seen" not in t.get(_txid(tx))
+    # bounded: the cap evicts oldest
+    for i in range(20):
+        t.admit(f"filler {i}".encode())
+    assert len(t) <= 8
+    assert t.evictions > 0
+    assert t.stats()["entries"] <= 8
+
+
+def test_provenance_disabled_records_nothing():
+    t = ProvenanceTable(sample=1.0, enabled=False)
+    assert not t.enabled
+    t.admit(b"x")
+    t.commit_batch([b"x"], 0, 0)
+    assert len(t) == 0
+    z = ProvenanceTable(sample=0.0)  # sample 0 == off
+    assert not z.enabled
+
+
+# -- unit: wire codec + backward compat -------------------------------------
+
+
+def test_trace_context_wire_codec_and_compat():
+    ctx = make_ctx("3-17", origin=3, ts_s=1234.5678901, hop=0)
+    assert isinstance(ctx["ts"], int)  # canonical codec rejects floats
+    for req in (
+        SyncRequest(1, {0: 2}, 50, trace=ctx),
+        EagerSyncRequest(1, [], trace=ctx),
+        FastForwardRequest(1, trace=ctx),
+    ):
+        d = json.loads(json.dumps(req.to_dict()))
+        back = type(req).from_dict(d)
+        assert parse_ctx(back.trace) == ctx
+        # an OLD receiver reads only the known keys — the extra "trace"
+        # key must not change what it parses
+        legacy = {k: v for k, v in d.items() if k != "trace"}
+        old = type(req).from_dict(legacy)
+        assert old.from_id == req.from_id and old.trace is None
+    # an OLD sender omits the field entirely
+    no_trace = SyncRequest(1, {0: 2}, 50).to_dict()
+    assert "trace" not in no_trace
+    assert SyncRequest.from_dict(no_trace).trace is None
+    # malformed contexts degrade to None, never raise
+    for bad in (None, "junk", 42, {}, {"id": "x"}, {"id": "x", "ts": "n/a"}):
+        assert parse_ctx(bad) is None
+    # hostile oversize ids are clamped
+    big = parse_ctx({"id": "A" * 10000, "ts": 1})
+    assert len(big["id"]) <= 64
+
+
+# -- cluster helpers --------------------------------------------------------
+
+
+def _make_cluster(n: int, transports, conf_extra=None) -> tuple:
+    keys = [generate_key() for _ in range(n)]
+    addrs = [t.advertise_addr() for t in transports]
+    peers = PeerSet(
+        [Peer(addrs[i], k.public_key.hex(), f"t{i}")
+         for i, k in enumerate(keys)]
+    )
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.01,
+            slow_heartbeat_timeout=0.2,
+            moniker=f"t{i}",
+            log_level="error",
+            trace_sample=1.0,
+            **(conf_extra or {}),
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        node = Node(conf, Validator(k, f"t{i}"), peers, peers,
+                    InmemStore(conf.cache_size), transports[i], pr)
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    return nodes, proxies, states
+
+
+def _wait_commit(states, tx: bytes, deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(tx in st.committed_txs for st in states):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{tx!r} did not commit everywhere in time")
+
+
+class _StripTraceTransport:
+    """Wrap a transport so OUTBOUND requests lose their trace field —
+    exactly what a peer running the previous wire framing sends."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _strip(self, req):
+        d = {k: v for k, v in req.to_dict().items() if k != "trace"}
+        return type(req).from_dict(json.loads(json.dumps(d)))
+
+    def sync(self, target, req):
+        return self._inner.sync(target, self._strip(req))
+
+    def eager_sync(self, target, req):
+        return self._inner.eager_sync(target, self._strip(req))
+
+    def fast_forward(self, target, req):
+        return self._inner.fast_forward(target, self._strip(req))
+
+    def join(self, target, req):
+        return self._inner.join(target, req)
+
+
+def test_backward_compat_peer_without_trace_field_syncs_cleanly():
+    """A new-framing node gossips with a peer that sends NO trace
+    context (old framing): commits land on both, nothing is rejected,
+    and no context is counted from the stripped side."""
+    net = InmemNetwork()
+    transports = [net.new_transport(f"inmem://bc{i}") for i in range(2)]
+    transports[1] = _StripTraceTransport(transports[1])
+    nodes, proxies, states = _make_cluster(2, transports)
+    try:
+        for n in nodes:
+            n.run_async()
+        assert proxies[1].submit_tx(b"old-style tx") == "accepted"
+        assert proxies[0].submit_tx(b"new-style tx") == "accepted"
+        _wait_commit(states, b"old-style tx")
+        _wait_commit(states, b"new-style tx")
+        # node 0 only ever hears stripped requests -> zero contexts seen;
+        # node 1 receives node 0's full-framing requests and counts them
+        assert nodes[0].trace_ctx_rpcs == 0
+        assert nodes[1].trace_ctx_rpcs > 0
+        for n in nodes:
+            assert n.sync_errors == 0
+            assert all(v == 0 for v in n.rpc_errors.values())
+        # the old-style tx still got origin-side provenance on node 1
+        rec = nodes[1].get_trace(_txid(b"old-style tx"))
+        assert rec is not None and "admit" in rec and "commit" in rec
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+# -- the tracesmoke: live TCP cluster, HTTP trace merge ---------------------
+
+
+@pytest.mark.trace
+def test_cluster_trace_merges_multi_hop_over_http():
+    """4-node TCP cluster with HTTP services, every tx traced: the
+    committed transaction's per-node /trace/<txid> records merge into
+    one timeline with admit -> self-event -> >= 2 gossip hops (monotone
+    first-seen stamps) -> commit on every node, with per-hop latency
+    attribution; /traces bulk + traceview.merge_all cover the same
+    ground."""
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.service.service import Service
+
+    transports = [
+        TCPTransport("127.0.0.1:0", max_pool=2, timeout=5.0)
+        for _ in range(4)
+    ]
+    for t in transports:
+        t.listen()  # resolve ephemeral ports before building the peerset
+    nodes, proxies, states = _make_cluster(4, transports)
+    services = []
+    try:
+        for n in nodes:
+            srv = Service("127.0.0.1:0", n, logger=None)
+            srv.serve_async()
+            services.append(srv)
+        for n in nodes:
+            n.run_async()
+        tx = b"traced tx 1"
+        assert proxies[0].submit_tx(tx) == "accepted"
+        _wait_commit(states, tx)
+        txid = _txid(tx)
+
+        exports = []
+        for srv in services:
+            exp = traceview.fetch_node(srv.bind_addr, txid=txid)
+            if exp is not None:
+                exports.append(exp)
+        assert len(exports) == 4, "every node should hold the record"
+        merged = traceview.merge_tx(txid, exports)
+        assert merged is not None
+        assert merged["origin"] == nodes[0].get_id()
+        assert merged["admit"] is not None and merged["drain"] is not None
+        # every non-origin node is one gossip hop; >= 2 prove multi-hop
+        assert len(merged["hops"]) >= 2, merged
+        assert merged["monotone"], merged
+        assert merged["committed_on"] == 4
+        assert merged["block"] is not None
+        assert merged["round_received"] is not None
+        assert merged["e2e_s"] is not None and merged["e2e_s"] >= 0
+        # attribution: every hop carries the insert split; at least one
+        # eager-pushed hop carries wire+queue from the carried context
+        assert all(h["insert_s"] is not None for h in merged["hops"])
+        assert all(
+            h["consensus_s"] is not None and h["consensus_s"] >= 0
+            for h in merged["hops"]
+        )
+        # the human renderer and the attribution summary both run
+        text = traceview.render(merged)
+        assert txid[:16] in text and "hop1" in text
+        summary = traceview.attribution_summary([merged])
+        assert summary["insert"]["n"] >= 2
+        assert summary["e2e"]["n"] == 1
+
+        # bulk export + merge_all (what --nodes scraping does)
+        bulk = [
+            traceview.fetch_node(srv.bind_addr, limit=64)
+            for srv in services
+        ]
+        merged_all = traceview.merge_all(bulk)
+        assert any(m["txid"] == txid for m in merged_all)
+
+        # /trace of an unknown txid is a clean 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{services[0].bind_addr}/trace/{'0' * 64}",
+                timeout=5.0,
+            )
+        assert ei.value.code == 404
+
+        # live contexts were actually carried on the wire
+        assert sum(n.trace_ctx_rpcs for n in nodes) > 0
+    finally:
+        for srv in services:
+            srv.shutdown()
+        for n in nodes:
+            n.shutdown()
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+@pytest.mark.trace
+def test_stall_watchdog_dumps_flight_artifact_on_gossip_kill(tmp_path):
+    """Killing gossip mid-run (severed links) on a busy node trips the
+    watchdog; the artifact names the stalled stage and carries the
+    diagnostic payload."""
+    net = InmemNetwork()
+    transports = [net.new_transport(f"inmem://fw{i}") for i in range(2)]
+    nodes, proxies, states = _make_cluster(2, transports)
+    try:
+        for n in nodes:
+            n.run_async()
+        assert proxies[0].submit_tx(b"warmup tx") == "accepted"
+        _wait_commit(states, b"warmup tx")
+
+        # kill gossip, then make node 0 busy with an uncommittable tx
+        net.disconnect("inmem://fw0", "inmem://fw1")
+        assert proxies[0].submit_tx(b"stranded tx") == "accepted"
+
+        wd = StallWatchdog(
+            nodes[0], stall_s=0.3, interval_s=0.05,
+            out_dir=str(tmp_path),
+        )
+        artifact = None
+        deadline = time.monotonic() + 20.0
+        while artifact is None and time.monotonic() < deadline:
+            artifact = wd.check()
+            time.sleep(0.05)
+        assert artifact is not None, "watchdog never tripped"
+        assert wd.trips == 1 and wd.dumps == 1
+        with open(artifact, encoding="utf-8") as f:
+            art = json.load(f)
+        assert art["format"] == "babble-flight/1"
+        assert art["stalled_stage"] == "gossip"
+        assert art["stalled_for_s"] >= 0.3
+        # the stranded tx is either still pending or already drained
+        # into an uncommitted self-event — both keep the node busy
+        q = art["queues"]
+        assert q["mempool_pending"] >= 1 or q["undetermined_events"] >= 1
+        assert "stats" in art and "recent_syncs" in art
+        assert "provenance_tail" in art
+        assert art["stats"]["last_block_index"] >= 0
+        # one dump per episode: no progress -> no second artifact
+        time.sleep(0.4)
+        assert wd.check() is None
+        # progress re-arms: heal, commit, stall again -> fresh trip
+        net.reconnect("inmem://fw0", "inmem://fw1")
+        _wait_commit(states, b"stranded tx")
+        net.disconnect("inmem://fw0", "inmem://fw1")
+        assert proxies[0].submit_tx(b"stranded tx 2") == "accepted"
+        second = None
+        deadline = time.monotonic() + 20.0
+        while second is None and time.monotonic() < deadline:
+            second = wd.check()
+            time.sleep(0.05)
+        assert second is not None and wd.trips == 2
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+def test_watchdog_quiet_when_idle_or_disabled(tmp_path):
+    """An idle (not busy) node never trips; stall_s=0 disables."""
+    net = InmemNetwork()
+    transports = [net.new_transport(f"inmem://wq{i}") for i in range(1)]
+    nodes, proxies, states = _make_cluster(1, transports)
+    try:
+        nodes[0].run_async()
+        wd = StallWatchdog(nodes[0], stall_s=0.1, interval_s=0.05,
+                           out_dir=str(tmp_path))
+        time.sleep(0.3)
+        assert wd.check() is None  # first pass records the signature
+        time.sleep(0.3)
+        assert wd.check() is None, "idle node must not trip"
+        off = StallWatchdog(nodes[0], stall_s=0.0, out_dir=str(tmp_path))
+        assert off.check() is None
+        off.start()
+        assert off._thread is None  # disabled: no monitor thread
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+# -- kill switch ------------------------------------------------------------
+
+
+def test_kill_switch_disables_tracing_end_to_end():
+    """With telemetry disabled the node emits no wire contexts and the
+    provenance table records nothing (BABBLE_OBS=0 contract; exercised
+    via the NodeTelemetry enabled flag the env var resolves to)."""
+    from babble_tpu.obs.telemetry import NodeTelemetry
+    from babble_tpu.node.core import Core
+
+    key = generate_key()
+    peers = PeerSet([Peer("inmem://ks0", key.public_key.hex(), "ks0")])
+
+    class _Resp:
+        state_hash = b""
+        receipts = []
+
+    core = Core(
+        Validator(key, "ks0"), peers, peers, InmemStore(1000),
+        lambda block: _Resp(),
+    )
+    tele = NodeTelemetry(core, enabled=False)
+    assert tele.wire_ctx(1) is None
+    assert not tele.provenance.enabled
+    tele.provenance.admit(b"x")
+    assert len(tele.provenance) == 0
+
+
+# -- traceview --from-json (the sim-harness merge path) ---------------------
+
+
+def test_traceview_merges_saved_exports(tmp_path, capsys):
+    """The CLI merges a saved list of /traces payloads — the format the
+    sim harness (SimCluster.provenance_exports) and saved scrapes
+    produce."""
+    t0 = 1000.0
+    exports = [
+        {"node": 1, "moniker": "a", "records": [
+            {"txid": "ab" * 32, "admit": t0, "drain": t0 + 0.002,
+             "commit": t0 + 0.050, "block": 2, "round_received": 3},
+        ]},
+        {"node": 2, "moniker": "b", "records": [
+            {"txid": "ab" * 32, "first_seen": t0 + 0.010, "from": 1,
+             "ctx": "1-4", "hop": 1, "recv": t0 + 0.008,
+             "wire_s": 0.001, "queue_s": 0.002, "insert_s": 0.002,
+             "commit": t0 + 0.055, "block": 2, "round_received": 3},
+        ]},
+        {"node": 3, "moniker": "c", "records": [
+            {"txid": "ab" * 32, "first_seen": t0 + 0.020, "from": 2,
+             "commit": t0 + 0.060, "block": 2, "round_received": 3},
+        ]},
+    ]
+    path = tmp_path / "exports.json"
+    path.write_text(json.dumps(exports))
+    rc = traceview.main(["--from-json", str(path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    (m,) = out["traces"]
+    assert m["origin"] == 1 and len(m["hops"]) == 2 and m["monotone"]
+    assert m["hops"][0]["node"] == 2 and m["hops"][1]["node"] == 3
+    assert m["committed_on"] == 3
+    assert out["attribution"]["wire"]["n"] == 1
+    # --txid filter + not-found exit code
+    assert traceview.main(
+        ["--from-json", str(path), "--txid", "ab" * 32]
+    ) == 0
+    assert traceview.main(
+        ["--from-json", str(path), "--txid", "cd" * 32]
+    ) == 1
