@@ -49,6 +49,8 @@ _RUN_FLAGS = {
     "moniker": ("moniker", str),
     "accelerator": ("accelerator", bool),
     "accelerator_mesh": ("accelerator_mesh", int),
+    "transport": ("transport", str),
+    "gossip_pipeline_depth": ("gossip_pipeline_depth", int),
     "mempool_max_txs": ("mempool_max_txs", int),
     "mempool_max_bytes": ("mempool_max_bytes", int),
     "mempool_overflow": ("mempool_overflow", str),
@@ -283,6 +285,17 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--accelerator-mesh", dest="accelerator_mesh", type=int, default=None,
         help="shard voting sweeps over this many devices (multi-chip)",
+    )
+    run.add_argument(
+        "--transport", default=None, choices=("tcp", "async"),
+        help="gossip transport: 'async' = event-driven selector engine "
+        "with the binary framed codec (docs/gossip.md); 'tcp' = "
+        "thread-per-connection JSON fallback (default)",
+    )
+    run.add_argument(
+        "--gossip-pipeline-depth", dest="gossip_pipeline_depth", type=int,
+        default=None,
+        help="bounded insert-queue depth of the inbound-sync pipeline",
     )
     run.add_argument(
         "--mempool-max-txs", dest="mempool_max_txs", type=int, default=None,

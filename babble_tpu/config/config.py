@@ -64,6 +64,19 @@ class Config:
     sync_limit: int = 1000
     suspend_limit: int = 100
 
+    # Async gossip engine (docs/gossip.md): "async" builds the
+    # event-driven selector transport (net/atcp.py — multiplexed
+    # connections, binary framed codec, per-connection version
+    # negotiation so JSON peers interoperate); "tcp" keeps the
+    # thread-per-connection fallback (net/tcp.py).
+    transport: str = "tcp"
+    # Inbound-sync pipeline (node/pipeline.py): concurrent decode +
+    # batch-verify stages feeding one serialized inserter through a
+    # bounded queue (depth = backpressure threshold). Auto-disabled
+    # under an injected sim clock (determinism).
+    gossip_pipeline: bool = True
+    gossip_pipeline_depth: int = 64
+
     # Resilience knobs (docs/robustness.md): total budget for the
     # catching-up node's fast-forward poll loop (each pass polls every
     # peer; transient failures retry with exponential backoff until the
@@ -177,6 +190,10 @@ class Config:
             self.bootstrap = True
         if self.bootstrap:
             self.store = True
+        if self.transport not in ("tcp", "async"):
+            raise ValueError(
+                f"transport must be 'tcp' or 'async', got {self.transport!r}"
+            )
         if self.mempool_overflow not in ("reject", "evict-oldest"):
             raise ValueError(
                 f"mempool_overflow must be 'reject' or 'evict-oldest', "

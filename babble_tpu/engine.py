@@ -105,6 +105,19 @@ class Babble:
                 ca_file=ca or None,
                 direct_listen=self.config.signal_direct or None,
             )
+        elif self.config.transport == "async":
+            # Event-driven engine (docs/gossip.md): selector loop,
+            # multiplexed connections, binary framed codec with per-
+            # connection version negotiation (JSON peers interoperate).
+            from .net.atcp import AsyncTCPTransport
+
+            self.transport = AsyncTCPTransport(
+                self.config.bind_addr,
+                advertise_addr=self.config.advertise_addr or None,
+                max_pool=self.config.max_pool,
+                timeout=self.config.tcp_timeout,
+                join_timeout=self.config.join_timeout,
+            )
         else:
             self.transport = TCPTransport(
                 self.config.bind_addr,

@@ -15,6 +15,7 @@ from .rpc import (
 from .transport import RemoteError, Transport, TransportError
 from .inmem import InmemNetwork, InmemTransport
 from .tcp import TCPTransport
+from .atcp import AsyncTCPTransport
 from .chaos import (
     ChaosController,
     ChaosTransport,
@@ -39,6 +40,7 @@ __all__ = [
     "InmemNetwork",
     "InmemTransport",
     "TCPTransport",
+    "AsyncTCPTransport",
     "ChaosController",
     "ChaosTransport",
     "LinkFaults",

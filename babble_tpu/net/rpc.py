@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import queue
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..crypto.canonical import PreNormalized
 from ..hashgraph.block import Block
@@ -261,10 +261,22 @@ class RPC:
         # queue, so the handler can split "wire" from "queue" time in
         # per-hop trace attribution. None when the transport predates it.
         self.recv_ts: Optional[float] = None
+        # Event-driven transports (net/atcp.py) set this instead of
+        # parking a thread on wait(): respond() invokes it in the
+        # handler's thread, so response serialization happens off the
+        # transport's event loop.
+        self.on_respond: Optional[Callable[[object, Optional[str]], None]] = None
         self._resp: "queue.Queue[Tuple[object, Optional[str]]]" = queue.Queue(1)
 
     def respond(self, result, error: Optional[str] = None) -> None:
         self._resp.put((result, error))
+        cb = self.on_respond
+        if cb is not None:
+            try:
+                cb(result, error)
+            except Exception:
+                # a dead connection must not crash the node's handler
+                pass
 
     def wait(self, timeout: Optional[float] = None):
         """Block for the handler's response. Returns (result, error_str)."""

@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..crypto.canonical import canonical_dumps
+from .codec import CODEC_STATS
 from .rpc import (
     JoinRequest,
     REQUEST_TYPES,
@@ -46,21 +47,45 @@ class _ConnError(TransportError):
     received, processed, and answered the request."""
 
 
+class _RecvBuffer:
+    """One reusable receive buffer per connection: ``recv_into`` a
+    pre-allocated bytearray instead of building each frame through
+    per-call ``bytes`` concatenation (which allocated and copied
+    O(chunks) intermediates per frame on the ingest hot path). The
+    buffer grows to the largest frame the connection has seen and is
+    reused for every subsequent read."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, initial: int = 1 << 16):
+        self._buf = bytearray(initial)
+
+    def recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        if n > MAX_FRAME:
+            raise ConnectionError(f"frame of {n} bytes exceeds limit")
+        if len(self._buf) < n:
+            self._buf = bytearray(n)
+        view = memoryview(self._buf)
+        got = 0
+        while got < n:
+            r = sock.recv_into(view[got:n])
+            if not r:
+                raise ConnectionError("connection closed")
+            got += r
+        CODEC_STATS.bytes_received += n
+        return bytes(view[:n])
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    if n > MAX_FRAME:
-        raise ConnectionError(f"frame of {n} bytes exceeds limit")
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("connection closed")
-        buf += chunk
-    return buf
+    """One-shot exact read (no connection to hang a buffer on)."""
+    return _RecvBuffer(min(n, 1 << 16)).recv_exact(sock, n)
 
 
 def _send_frame(sock: socket.socket, type_byte: Optional[int], payload: bytes) -> None:
     head = bytes([type_byte]) if type_byte is not None else b""
-    sock.sendall(head + struct.pack(">I", len(payload)) + payload)
+    data = head + struct.pack(">I", len(payload)) + payload
+    sock.sendall(data)
+    CODEC_STATS.bytes_sent += len(data)
 
 
 class TCPTransport:
@@ -166,11 +191,12 @@ class TCPTransport:
     def _handle_conn(self, conn: socket.socket) -> None:
         """One request/response at a time per connection
         (reference: net_transport.go:355-441)."""
+        rbuf = _RecvBuffer()  # reused across every frame on this conn
         try:
             while not self._shutdown.is_set():
-                type_byte = _recv_exact(conn, 1)[0]
-                (length,) = struct.unpack(">I", _recv_exact(conn, 4))
-                payload = _recv_exact(conn, length)
+                type_byte = rbuf.recv_exact(conn, 1)[0]
+                (length,) = struct.unpack(">I", rbuf.recv_exact(conn, 4))
+                payload = rbuf.recv_exact(conn, length)
                 req_cls = REQUEST_TYPES.get(type_byte)
                 if req_cls is None:
                     _send_frame(
@@ -283,12 +309,13 @@ class TCPTransport:
         req,
         timeout: Optional[float],
     ):
+        rbuf = _RecvBuffer()  # reused for both reads of this round trip
         try:
             if timeout is not None:
                 sock.settimeout(timeout)
             _send_frame(sock, type_byte, canonical_dumps(req.to_dict()))
-            (length,) = struct.unpack(">I", _recv_exact(sock, 4))
-            body = json.loads(_recv_exact(sock, length))
+            (length,) = struct.unpack(">I", rbuf.recv_exact(sock, 4))
+            body = json.loads(rbuf.recv_exact(sock, length))
         except socket.timeout as err:
             # A timeout means the peer is slow or gone, NOT that the pooled
             # socket was stale — retrying would double the worst-case RPC

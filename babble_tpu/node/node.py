@@ -173,6 +173,21 @@ class Node(StateManager):
         # threads onto core_lock under the GIL (the Go reference relies on
         # cheap goroutines; here 2 in flight keeps the pipeline full).
         self._gossip_slots = threading.Semaphore(2)
+        # Inbound-sync pipeline (node/pipeline.py): decode+batch-verify
+        # overlap across handler threads, the insert tail drains through
+        # one serialized inserter, bounded queue backpressures the
+        # transport. Wall-clock only — the deterministic sim engine
+        # drives _process_rpc single-threaded under virtual time, where
+        # a background inserter would break replay determinism.
+        from ..common.clock import WALL
+
+        self.pipeline = None
+        if conf.gossip_pipeline and self.clock is WALL:
+            from .pipeline import SyncPipeline
+
+            self.pipeline = SyncPipeline(
+                self, queue_cap=conf.gossip_pipeline_depth
+            )
         self.telemetry.bind_node(self)
 
     # -- lifecycle ----------------------------------------------------------
@@ -320,6 +335,8 @@ class Node(StateManager):
             self._transition(State.SHUTDOWN)
             self.shutdown_event.set()
             self.watchdog.stop()
+            if self.pipeline is not None:
+                self.pipeline.stop()
             self.control_timer.shutdown()
             self.wait_routines(timeout=2.0)
             if self.trans is not None:
@@ -436,6 +453,22 @@ class Node(StateManager):
         stats["flight_dumps"] = self.watchdog.dumps
         stats.update(self.core.peer_selector.stats())
         stats["sync_limit_truncations"] = self.sync_limit_truncations
+        # Async gossip engine surface (docs/gossip.md): inbound-sync
+        # pipeline occupancy + the process-wide binary codec tallies.
+        if self.pipeline is not None:
+            stats.update(self.pipeline.stats())
+        else:
+            stats.update({
+                "gossip_inflight_syncs": 0,
+                "gossip_inflight_syncs_peak": 0,
+                "gossip_pipelined_syncs": 0,
+                "gossip_backpressure_stalls": 0,
+            })
+        from ..net.codec import CODEC_STATS
+
+        stats.update({
+            f"codec_{k}": v for k, v in CODEC_STATS.snapshot().items()
+        })
         stats.update(self.core.sentry.stats())
         # Commit-latency percentiles from the registry histogram — the
         # north-star p50/p90/p99 (ms), None until the first local commit.
@@ -467,7 +500,14 @@ class Node(StateManager):
             try:
                 rpc = net_q.get(timeout=0.01)
                 handled = True
-                self.go_func(lambda r=rpc: (self._process_rpc(r), self._reset_timer()))
+                started = self.go_func(
+                    lambda r=rpc: (self._process_rpc(r), self._reset_timer())
+                )
+                if not started:
+                    # routine pool exhausted: answer instead of dropping
+                    # silently, so the caller fails fast rather than
+                    # burning its full RPC timeout (backpressure surface)
+                    rpc.respond(None, "node busy (routine pool exhausted)")
             except queue.Empty:
                 pass
             # Batch-drain the submit queue, BOUNDED per pass: the old
@@ -891,8 +931,6 @@ class Node(StateManager):
 
     def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
         """reference: node_rpc.go:180-203."""
-        success = True
-        err: Optional[str] = None
         if len(cmd.events) > self.conf.sync_limit:
             # Receiving-side cap: the requester-side truncation
             # (node.py _push) is a courtesy honest peers extend; a
@@ -907,21 +945,53 @@ class Node(StateManager):
             self.sync_limit_truncations += 1
             if egregious:
                 self.core.sentry.record(cmd.from_id, "oversized_sync")
+        hop = None
+        if self.telemetry.enabled:
+            hop = {
+                "from": cmd.from_id,
+                "ctx": parse_ctx(cmd.trace),
+                # transport arrival when stamped; else handler entry
+                "recv": (
+                    rpc.recv_ts if rpc.recv_ts is not None
+                    else self.clock.time()
+                ),
+            }
+        # Pipelined path (node/pipeline.py): decode+batch-verify run in
+        # THIS thread (stage 1, lock-free, overlapped across concurrent
+        # inbound syncs), the insert tail drains through the serialized
+        # inserter, and the response fires after the insert lands.
+        if self.pipeline is not None and self.pipeline.submit(rpc, cmd, hop):
+            return
+        # Inline fallback (pipeline disabled or stopped): the
+        # pre-pipeline shape — same lock-shrink, same error surface.
         try:
-            # Same lock-shrink as _pull: the batch decode+verify stage
-            # runs before the lock, the lock covers only the inserts.
-            hop = None
-            if self.telemetry.enabled:
-                hop = {
-                    "from": cmd.from_id,
-                    "ctx": parse_ctx(cmd.trace),
-                    # transport arrival when stamped; else handler entry
-                    "recv": (
-                        rpc.recv_ts if rpc.recv_ts is not None
-                        else self.clock.time()
-                    ),
-                }
             prepared = self.core.prepare_sync(cmd.events)
+        except Exception as e:
+            self._fail_eager_sync(rpc, cmd, e)
+            return
+        self._finish_eager_sync(rpc, cmd, prepared, hop)
+
+    def _fail_eager_sync(self, rpc: RPC, cmd: EagerSyncRequest,
+                         e: Exception) -> None:
+        """Answer an eager sync whose prepare stage raised, preserving
+        the pre-pipeline error attribution: classified (peer-fault)
+        rejections score the sender through the sentry; only genuine
+        handler crashes count toward rpc_errors."""
+        cause = self.core.sentry.observe_rejection(e, cmd.from_id)
+        if cause is None:
+            self.rpc_errors["eager_sync"] += 1
+        self.logger.debug("eager-sync prepare error: %s", e, exc_info=True)
+        rpc.respond(EagerSyncResponse(self.get_id(), False), str(e))
+
+    def _finish_eager_sync(self, rpc: RPC, cmd: EagerSyncRequest,
+                           prepared, hop: Optional[dict]) -> None:
+        """Insert tail of one inbound eager sync + the response. Called
+        by the pipeline's inserter thread (or inline when the pipeline
+        is off); ``prepared`` is the lock-free stage's output for
+        ``cmd.events``."""
+        success = True
+        err: Optional[str] = None
+        try:
             with self.core_lock:
                 self._sync(cmd.from_id, cmd.events, prepared, hop)
         except Exception as e:

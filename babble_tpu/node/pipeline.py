@@ -1,0 +1,167 @@
+"""SyncPipeline: bounded decode → batch-verify → insert staging for
+inbound eager syncs.
+
+The seed shape ran each inbound sync's whole life on one routine
+thread: decode + batch-verify (lock-free since the batched-ingest fast
+path) and then the insert tail under the core lock. Under 16-node load
+that parks a pile of handler threads on the core lock, each holding its
+decoded batch, convoying on the GIL (the `lock_wait_ms` counters from
+PR 1 localize exactly this).
+
+This pipeline splits the stages explicitly:
+
+- **Stage 1 (caller thread, lock-free, concurrent):** decode + one
+  native batch signature verification per sync (``Core.prepare_sync``)
+  — many inbound syncs overlap here.
+- **Stage 2 (one inserter thread, serialized):** the ordered insert +
+  DivideRounds tail under the core lock — the only part that MUST be
+  serial, drained by a single thread so handler threads never queue on
+  the lock itself.
+
+The hand-off queue is **bounded**: when inserts fall behind, submitters
+block (briefly) and then run the insert inline — so the transport's
+read loop ultimately slows down instead of the node buffering
+unbounded decoded batches (backpressure). The ``inflight`` gauge (and
+its high-water mark) is the `gossip_inflight_syncs` instrument.
+
+The pipeline is wall-clock only: the deterministic sim engine drives
+``_process_rpc`` single-threaded under virtual time, where a background
+inserter thread would break replay determinism — Node only constructs
+the pipeline when its clock is the process wall clock.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+
+class SyncPipeline:
+    def __init__(self, node, queue_cap: int = 64, submit_timeout: float = 5.0):
+        self.node = node
+        self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=max(1, queue_cap))
+        self._submit_timeout = submit_timeout
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # -- instruments (obs/catalog.py: gossip_*) --
+        self.inflight = 0            # syncs between submit and respond
+        self.inflight_peak = 0       # high-water mark
+        self.pipelined_syncs = 0     # syncs that went through the queue
+        self.backpressure_stalls = 0  # submits that found the queue full
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            with self._lock:
+                if self._thread is None or not self._thread.is_alive():
+                    if self._stop.is_set():
+                        return
+                    self._thread = threading.Thread(
+                        target=self._insert_loop, daemon=True,
+                        name="sync-inserter",
+                    )
+                    self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._drain_stopped()
+
+    def _drain_stopped(self) -> None:
+        """Politely fail anything still queued so clients see an error
+        instead of a silent timeout. Called by stop() and by any
+        submit() that raced past the stop check — either way every
+        queued RPC gets an answer and the inflight gauge balances."""
+        while True:
+            try:
+                rpc, _cmd, _prepared, _hop = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._dec_inflight()
+            try:
+                rpc.respond(None, "node shutting down")
+            except Exception:
+                pass
+
+    # -- stages --------------------------------------------------------------
+
+    def submit(self, rpc, cmd, hop) -> bool:
+        """Stage 1 in the caller's thread, then enqueue the insert tail.
+        Returns False when the pipeline is stopped (caller handles the
+        sync inline, the pre-pipeline shape)."""
+        if self._stop.is_set():
+            return False
+        self._ensure_thread()
+        if self._thread is None:
+            return False
+        with self._lock:
+            self.inflight += 1
+            if self.inflight > self.inflight_peak:
+                self.inflight_peak = self.inflight
+        try:
+            prepared = self.node.core.prepare_sync(cmd.events)
+        except Exception as e:
+            # answer here rather than returning False: the inline
+            # fallback would re-run the whole decode + native batch
+            # verify, doubling the CPU a hostile malformed batch costs.
+            # _fail_eager_sync keeps the sentry attribution (peer-fault
+            # rejections score the sender, crashes count rpc_errors).
+            self._dec_inflight()
+            try:
+                self.node._fail_eager_sync(rpc, cmd, e)
+            except Exception:
+                pass
+            return True
+        if self._q.full():
+            self.backpressure_stalls += 1
+        try:
+            self._q.put((rpc, cmd, prepared, hop),
+                        timeout=self._submit_timeout)
+        except queue.Full:
+            # sustained pressure: do the insert on this thread — the
+            # submitter (and through it the transport) pays the cost,
+            # which is exactly the backpressure contract
+            try:
+                self.node._finish_eager_sync(rpc, cmd, prepared, hop)
+            finally:
+                self._dec_inflight()
+            return True
+        if self._stop.is_set():
+            # raced with stop(): the inserter may already be gone and
+            # stop()'s drain may have run before our put landed —
+            # drain again so this RPC cannot hang unanswered
+            self._drain_stopped()
+        self.pipelined_syncs += 1
+        return True
+
+    def _insert_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                rpc, cmd, prepared, hop = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self.node._finish_eager_sync(rpc, cmd, prepared, hop)
+            except Exception:
+                # _finish_eager_sync responds internally; a crash here
+                # must not kill the inserter for every later sync
+                pass
+            finally:
+                self._dec_inflight()
+
+    def _dec_inflight(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    def stats(self) -> dict:
+        return {
+            "gossip_inflight_syncs": self.inflight,
+            "gossip_inflight_syncs_peak": self.inflight_peak,
+            "gossip_pipelined_syncs": self.pipelined_syncs,
+            "gossip_backpressure_stalls": self.backpressure_stalls,
+        }

@@ -107,6 +107,26 @@ CATALOG: Tuple[Instrument, ...] = (
         "Transactions sitting in the proxy submit queue (sampled at "
         "scrape).",
     ),
+    # -- async gossip engine (docs/gossip.md) -------------------------------
+    Instrument(
+        "gossip_inflight_syncs", _G, (), "node",
+        "Inbound syncs currently in the decode→verify→insert pipeline "
+        "(between submit and response).",
+    ),
+    Instrument(
+        "gossip_inflight_syncs_peak", _G, (), "node",
+        "High-water mark of gossip_inflight_syncs.",
+    ),
+    Instrument(
+        "gossip_pipelined_syncs_total", _C, (), "node",
+        "Inbound syncs that went through the pipeline's bounded insert "
+        "queue (vs handled inline).",
+    ),
+    Instrument(
+        "gossip_backpressure_stalls_total", _C, (), "node",
+        "Pipeline submits that found the insert queue full "
+        "(backpressure propagating to the transport).",
+    ),
     # -- consensus progress -------------------------------------------------
     Instrument(
         "node_last_block_index", _G, (), "node",
@@ -335,6 +355,40 @@ CATALOG: Tuple[Instrument, ...] = (
     Instrument(
         "verify_cache_misses_total", _C, (), "global",
         "Signature-verdict cache misses (process-wide).",
+    ),
+    Instrument(
+        "wire_bytes_sent_total", _C, (), "global",
+        "Bytes written to gossip sockets, all transports and protocols "
+        "(process-wide).",
+    ),
+    Instrument(
+        "wire_bytes_received_total", _C, (), "global",
+        "Bytes read from gossip sockets, all transports and protocols "
+        "(process-wide).",
+    ),
+    Instrument(
+        "codec_events_encoded_total", _C, (), "global",
+        "Wire events encoded into binary blobs (blob-memo misses; "
+        "process-wide).",
+    ),
+    Instrument(
+        "codec_event_cache_hits_total", _C, (), "global",
+        "Event sends served from the binary blob memo — one encode per "
+        "event per process, however many peers it is pushed to.",
+    ),
+    Instrument(
+        "codec_events_decoded_total", _C, (), "global",
+        "Binary event blobs decoded at ingest (process-wide).",
+    ),
+    Instrument(
+        "codec_conns_binary_total", _C, (), "global",
+        "Inbound connections that negotiated the binary protocol "
+        "(process-wide).",
+    ),
+    Instrument(
+        "codec_conns_json_total", _C, (), "global",
+        "Inbound connections that fell back to the legacy JSON framing "
+        "(process-wide).",
     ),
 )
 

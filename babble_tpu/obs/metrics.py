@@ -510,4 +510,41 @@ def wire_global() -> None:
             "Process-wide signature-verdict cache misses.",
             lambda: VERIFY_CACHE.misses,
         )
+        from ..net.codec import CODEC_STATS
+
+        GLOBAL.func_counter(
+            "wire_bytes_sent_total",
+            "Process-wide bytes written to gossip sockets.",
+            lambda: CODEC_STATS.bytes_sent,
+        )
+        GLOBAL.func_counter(
+            "wire_bytes_received_total",
+            "Process-wide bytes read from gossip sockets.",
+            lambda: CODEC_STATS.bytes_received,
+        )
+        GLOBAL.func_counter(
+            "codec_events_encoded_total",
+            "Process-wide wire events encoded into binary blobs.",
+            lambda: CODEC_STATS.events_encoded,
+        )
+        GLOBAL.func_counter(
+            "codec_event_cache_hits_total",
+            "Process-wide event sends served from the binary blob memo.",
+            lambda: CODEC_STATS.event_cache_hits,
+        )
+        GLOBAL.func_counter(
+            "codec_events_decoded_total",
+            "Process-wide binary event blobs decoded at ingest.",
+            lambda: CODEC_STATS.events_decoded,
+        )
+        GLOBAL.func_counter(
+            "codec_conns_binary_total",
+            "Process-wide inbound connections negotiated binary.",
+            lambda: CODEC_STATS.conns_binary,
+        )
+        GLOBAL.func_counter(
+            "codec_conns_json_total",
+            "Process-wide inbound connections on the legacy JSON framing.",
+            lambda: CODEC_STATS.conns_json,
+        )
         _global_wired = True

@@ -358,6 +358,27 @@ class NodeTelemetry:
         self._func(
             "trace_ctx_rpcs_total", lambda: node.trace_ctx_rpcs
         )
+        # Async gossip engine (docs/gossip.md): pipeline occupancy.
+        # node.pipeline is None when the pipeline is disabled (sim clock
+        # or config) — the instruments then read 0.
+        self._func(
+            "gossip_inflight_syncs",
+            lambda: node.pipeline.inflight if node.pipeline else 0,
+        )
+        self._func(
+            "gossip_inflight_syncs_peak",
+            lambda: node.pipeline.inflight_peak if node.pipeline else 0,
+        )
+        self._func(
+            "gossip_pipelined_syncs_total",
+            lambda: node.pipeline.pipelined_syncs if node.pipeline else 0,
+        )
+        self._func(
+            "gossip_backpressure_stalls_total",
+            lambda: (
+                node.pipeline.backpressure_stalls if node.pipeline else 0
+            ),
+        )
         self._func(
             "watchdog_trips_total",
             lambda: getattr(node.watchdog, "trips", 0),

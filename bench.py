@@ -579,12 +579,15 @@ def bench_dag_pipeline_guarded():
 
 
 def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02,
-                      accelerator: bool = False):
-    """Full nodes over localhost TCP (BASELINE.md config 3 topology)."""
+                      accelerator: bool = False, transport: str = "tcp"):
+    """Full nodes over localhost TCP (BASELINE.md config 3 topology).
+    ``transport="async"`` runs the event-driven engine + binary codec
+    (docs/gossip.md) instead of the threaded JSON fallback."""
     from babble_tpu.config.config import Config
     from babble_tpu.crypto.keys import generate_key
     from babble_tpu.dummy.state import State as DummyState
     from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.atcp import AsyncTCPTransport
     from babble_tpu.net.tcp import TCPTransport
     from babble_tpu.node.node import Node
     from babble_tpu.node.validator import Validator
@@ -600,6 +603,7 @@ def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02,
         ]
     )
     addr = {p.pub_key_hex: p.net_addr for p in peers.peers}
+    trans_cls = AsyncTCPTransport if transport == "async" else TCPTransport
     nodes, proxies, states = [], [], []
     for i, k in enumerate(keys):
         conf = Config(
@@ -608,10 +612,11 @@ def _make_tcp_cluster(n_nodes: int, base_port: int, heartbeat: float = 0.02,
             log_level="error",
             moniker=f"t{i}",
             accelerator=accelerator,
+            transport=transport,
         )
         st = DummyState()
         pr = InmemProxy(st)
-        trans = TCPTransport(addr[k.public_key.hex()], timeout=2.0)
+        trans = trans_cls(addr[k.public_key.hex()], timeout=2.0)
         node = Node(conf, Validator(k, f"t{i}"), peers, peers,
                     InmemStore(conf.cache_size), trans, pr)
         node.init()
@@ -713,19 +718,81 @@ def bench_socket_proxy(window_s: float = 10.0):
         client.close()
 
 
+def _scrape_cluster_http(base_service: int, n: int) -> dict:
+    """Live-cluster digest over HTTP: commit-latency p50/p99 from node
+    0's Prometheus /metrics histogram, the inflight-sync high-water mark
+    across every node's /stats, and a no-fork verdict (the Body of a
+    block index committed by ALL nodes must be byte-identical)."""
+    import urllib.request
+
+    def _get(url, timeout=5.0):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read()
+
+    out: dict = {}
+    try:
+        text = _get(f"http://127.0.0.1:{base_service}/metrics").decode()
+        hist = _parse_prom_histogram(text, "commit_latency_seconds")
+        to_ms = lambda v: None if v is None else round(1e3 * v, 1)  # noqa: E731
+        out["clat_samples"] = 0 if hist is None else hist["count"]
+        out["clat_p50_ms"] = to_ms(_prom_hist_quantile(hist, 0.50))
+        out["clat_p99_ms"] = to_ms(_prom_hist_quantile(hist, 0.99))
+
+        stats = [
+            json.loads(_get(f"http://127.0.0.1:{base_service + i}/stats",
+                            timeout=2.0))
+            for i in range(n)
+        ]
+        def _num(s, key, default):
+            # /stats values are strings; "0" must stay 0 (an `or`
+            # fallback would eat a falsy TYPED zero if the surface
+            # ever returns numbers)
+            v = s.get(key)
+            return default if v is None or v == "" else int(v)
+
+        out["gossip_inflight_peak_max"] = max(
+            _num(s, "gossip_inflight_syncs_peak", 0) for s in stats
+        )
+        last = min(_num(s, "last_block_index", -1) for s in stats)
+        out["common_block_index"] = last
+        if last >= 0:
+            bodies = {
+                json.dumps(
+                    json.loads(
+                        _get(f"http://127.0.0.1:{base_service + i}"
+                             f"/block/{last}")
+                    )["Body"],
+                    sort_keys=True,
+                )
+                for i in range(n)
+            }
+            out["no_fork"] = len(bodies) == 1
+        else:
+            out["no_fork"] = None  # nothing committed yet
+    except Exception as err:
+        out["scrape_error"] = f"{type(err).__name__}: {err}"
+    return out
+
+
 def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
                              startup_timeout: float = 120.0,
                              accelerator: bool = False,
                              base_port: int = 23000,
                              warmup_s: float = 8.0,
                              heartbeat: float = 0.02,
-                             max_backlog: int = 2000):
+                             max_backlog: int = 2000,
+                             transport: str = "tcp"):
     """Full nodes as separate OS processes (one `babble_tpu run` each, the
     demo/testnet.py topology) with in-bench socket-proxy clients. Escapes
     the GIL: each node gets its own interpreter, like the reference's
     per-process Go nodes — so this is the honest per-node cost measurement
     (in-process clusters serialize all nodes' sweeps on one GIL).
-    Returns (txs_per_s, p50_ms, p95_ms)."""
+    ``transport="async"`` runs every child on the event-driven engine +
+    binary codec (docs/gossip.md) — the --nodes16proc comparison arm.
+    Returns (txs_per_s, p50_ms, p95_ms, extra) where ``extra`` carries
+    the LIVE /metrics commit-latency percentiles (node 0's histogram),
+    the cluster-wide inflight-sync high-water mark from /stats, and a
+    no-fork verdict over a committed block index common to all nodes."""
     import shutil
     import subprocess
     import tempfile
@@ -765,6 +832,8 @@ def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
                    "--client-connect", f"127.0.0.1:{base_client + i}",
                    "--heartbeat", str(heartbeat), "--slow-heartbeat", "0.5",
                    "--moniker", f"b{i}", "--log", "error"]
+            if transport != "tcp":
+                cmd += ["--transport", transport]
             if accelerator:
                 cmd.append("--accelerator")
             env = {**os.environ,
@@ -822,10 +891,13 @@ def bench_subprocess_cluster(window_s: float = 20.0, n: int = 16,
         p50, p95, _ = states[0].latency_percentiles(
             since=time.monotonic() - window_s
         )
+        extra = _scrape_cluster_http(base_service, n)
+        extra["transport"] = transport
         return (
             rate,
             round(1e3 * p50, 1) if p50 is not None else None,
             round(1e3 * p95, 1) if p95 is not None else None,
+            extra,
         )
     finally:
         for p in procs:
@@ -1566,11 +1638,14 @@ def bench_pallas_guarded(timeout_s: float = 420.0):
     )
 
 
-def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
+def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False,
+                         transport: str = "tcp", base_port: int = 0):
     """Config 3 (threaded): 16 full TCP nodes in one process, oracle vs
     accelerated. The GIL serializes all nodes, but at 16 validators the
     undecided windows are finally big enough for device sweeps to engage —
     this is the live-cluster engagement proof for the crossover table.
+    ``transport="async"`` pins the event-driven engine (docs/gossip.md)
+    against this threaded baseline on the same topology.
     Returns (txs_per_s, accel_stats_of_busiest_node_or_None)."""
     if accelerator:
         os.environ["BABBLE_PREWARM_BLOCK"] = "1"
@@ -1580,13 +1655,34 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
     # (hashgraph/sweep_batcher.py) — the BASELINE config-3 architecture.
     # On CPU-XLA fallback captures sync sweeps stay un-batched (measured
     # 2.7x regression when a central dispatcher convoys sync sweeps).
+    if not base_port:
+        base_port = 28700 if accelerator else 28100
+        if transport == "async":
+            base_port += 1600
     nodes, proxies, states = _make_tcp_cluster(
-        16, 28700 if accelerator else 28100, heartbeat=0.05,
-        accelerator=accelerator,
+        16, base_port, heartbeat=0.05,
+        accelerator=accelerator, transport=transport,
     )
     try:
         rate = _measure(nodes, proxies, states, window_s, warmup_s=8.0)
         stats = None
+        if transport == "async":
+            # Engine-occupancy digest: how hard the inbound-sync
+            # pipeline ran (docs/gossip.md).
+            stats = {
+                "gossip_inflight_peak_max": max(
+                    (n.pipeline.inflight_peak if n.pipeline else 0)
+                    for n in nodes
+                ),
+                "gossip_pipelined_syncs_total": sum(
+                    n.pipeline.pipelined_syncs if n.pipeline else 0
+                    for n in nodes
+                ),
+                "gossip_backpressure_stalls_total": sum(
+                    n.pipeline.backpressure_stalls if n.pipeline else 0
+                    for n in nodes
+                ),
+            }
         if accelerator:
             from babble_tpu.ops.device import describe
 
@@ -1595,6 +1691,7 @@ def bench_16node_threads(window_s: float = 12.0, accelerator: bool = False):
                 all_stats, key=lambda s: int(s.get("accel_sweeps") or 0)
             )
             stats = {
+                **(stats or {}),
                 "accel_sweeps_total": sum(
                     int(s.get("accel_sweeps") or 0) for s in all_stats
                 ),
@@ -1746,7 +1843,7 @@ def main_all() -> None:
     out["config2_socket_proxy_txs_per_s"] = round(rate2, 1)
     print(f"config 2 (socket proxy, 2 nodes): {rate2:.1f} tx/s", file=sys.stderr)
     try:
-        rate3, p50_3, p95_3 = bench_subprocess_cluster()
+        rate3, p50_3, p95_3, _ = bench_subprocess_cluster()
         out["config3_16node_procs_txs_per_s"] = round(rate3, 1)
         out["config3_16node_procs_latency_p50_ms"] = p50_3
         out["config3_16node_procs_latency_p95_ms"] = p95_3
@@ -1992,7 +2089,110 @@ def main_dag(smoke: bool = False) -> None:
     print(line)
 
 
+def main_gossip(smoke: bool = False) -> None:
+    """`--gossip [--smoke]`: the async-engine comparison by itself
+    (docs/gossip.md).
+
+    Smoke (`make gossipsmoke`): an 8-node MULTI-PROCESS cluster on the
+    async engine — asserts liveness (committed tx/s > 0), no-fork over a
+    block index committed cluster-wide, and a populated commit-latency
+    histogram scraped from the children's live /metrics. ONE JSON line.
+
+    Full: threaded AND multi-process 16-node configurations, old engine
+    vs new, with the tx/s ratio and inflight-sync high-water mark."""
+    if smoke:
+        rate, p50, _p95, extra = bench_subprocess_cluster(
+            window_s=8.0, n=8, heartbeat=0.05, max_backlog=500,
+            base_port=25500, warmup_s=5.0, transport="async",
+            startup_timeout=240.0,
+        )
+        res = {
+            "bench_summary": "gossip_smoke",
+            "nodes": 8,
+            "engine": "async",
+            "txs_per_s": round(rate, 1),
+            "latency_p50_ms": p50,
+            **extra,
+        }
+        line = json.dumps(res, separators=(",", ":"))
+        assert len(line) < 2000, "gossip summary exceeded tail budget"
+        print(line)
+        assert rate > 0, res                      # liveness
+        assert res.get("no_fork") is True, res    # byte-identical bodies
+        assert (res.get("clat_samples") or 0) > 0, res  # histogram live
+        return
+
+    out: dict = {}
+    for label, trans in (("tcp", "tcp"), ("async", "async")):
+        r, stats = bench_16node_threads(
+            window_s=12.0, transport=trans,
+            base_port=27100 if trans == "tcp" else 27350,
+        )
+        out[f"threads_{label}"] = {"txs_per_s": round(r, 1), **(stats or {})}
+        print(f"16-node threads {label}: {r:.1f} tx/s", file=sys.stderr)
+    for label, trans, bp in (("tcp", "tcp", 26000), ("async", "async", 26500)):
+        r, p50, _p95, extra = bench_subprocess_cluster(
+            window_s=15.0, heartbeat=0.1, max_backlog=100,
+            base_port=bp, transport=trans, startup_timeout=240.0,
+        )
+        out[f"procs_{label}"] = {
+            "txs_per_s": round(r, 1), "latency_p50_ms": p50, **extra,
+        }
+        print(
+            f"16-node procs {label}: {r:.1f} tx/s "
+            f"clat_p99={extra.get('clat_p99_ms')}ms",
+            file=sys.stderr,
+        )
+
+    def _r(new, old):
+        return round(new / old, 2) if new and old else None
+
+    out["threads_ratio"] = _r(
+        out["threads_async"]["txs_per_s"], out["threads_tcp"]["txs_per_s"]
+    )
+    out["procs_ratio"] = _r(
+        out["procs_async"]["txs_per_s"], out["procs_tcp"]["txs_per_s"]
+    )
+    line = json.dumps({"bench_summary": "gossip", **out},
+                      separators=(",", ":"))
+    print(line if len(line) < 2000 else _compact_summary(
+        {"bench_summary": "gossip", **out}
+    ))
+
+
+def main_nodes16proc() -> None:
+    """`--nodes16proc`: the real multi-process 16-node configuration —
+    threaded-JSON baseline vs the async engine on identical topology,
+    committed tx/s + commit-latency p50/p99 from live /metrics."""
+    out: dict = {}
+    for label, trans, bp in (("tcp", "tcp", 26000), ("async", "async", 26500)):
+        r, p50, p95, extra = bench_subprocess_cluster(
+            window_s=15.0, heartbeat=0.1, max_backlog=100,
+            base_port=bp, transport=trans, startup_timeout=240.0,
+        )
+        out[label] = {
+            "txs_per_s": round(r, 1),
+            "latency_p50_ms": p50,
+            "latency_p95_ms": p95,
+            **extra,
+        }
+        print(
+            f"16-node procs {label}: {r:.1f} tx/s p50={p50}ms "
+            f"clat_p99={extra.get('clat_p99_ms')}ms "
+            f"no_fork={extra.get('no_fork')}",
+            file=sys.stderr,
+        )
+    tcp_r, async_r = out["tcp"]["txs_per_s"], out["async"]["txs_per_s"]
+    out["ratio"] = round(async_r / tcp_r, 2) if tcp_r and async_r else None
+    print(json.dumps({"bench_summary": "nodes16proc", **out},
+                     separators=(",", ":")))
+
+
 def main() -> None:
+    if "--gossip" in sys.argv:
+        return main_gossip("--smoke" in sys.argv)
+    if "--nodes16proc" in sys.argv:
+        return main_nodes16proc()
     if "--dag" in sys.argv:
         return main_dag("--smoke" in sys.argv)
     if "--mempool" in sys.argv:
@@ -2081,12 +2281,19 @@ def main() -> None:
         crossover = {"error": f"{type(err).__name__}: {err}"}
         print(f"crossover bench failed: {err}", file=sys.stderr)
 
-    # Config 3 (threaded 16-node), oracle vs accelerated (sweep engagement
-    # in a live cluster).
+    # Config 3 (threaded 16-node): oracle vs accelerated (sweep
+    # engagement in a live cluster) vs the async gossip engine
+    # (docs/gossip.md — the ROADMAP item-1 comparison arm).
     config3_threads = {}
-    for label, acc16 in (("oracle", False), ("accelerated", True)):
+    for label, acc16, trans16 in (
+        ("oracle", False, "tcp"),
+        ("accelerated", True, "tcp"),
+        ("async_engine", False, "async"),
+    ):
         try:
-            rate16, stats16 = bench_16node_threads(accelerator=acc16)
+            rate16, stats16 = bench_16node_threads(
+                accelerator=acc16, transport=trans16
+            )
             config3_threads[label] = {"txs_per_s": round(rate16, 1)}
             if stats16:
                 config3_threads[label].update(stats16)
@@ -2094,7 +2301,7 @@ def main() -> None:
                 f"16-node threads {label}: {rate16:.1f} tx/s"
                 + (f" sweeps={stats16['accel_sweeps_total']}"
                    f" fallbacks={stats16['accel_fallbacks_total']}"
-                   if stats16 else ""),
+                   if stats16 and "accel_sweeps_total" in stats16 else ""),
                 file=sys.stderr,
             )
         except Exception as err:
@@ -2106,7 +2313,7 @@ def main() -> None:
     procs = {}
     for label, acc in (("oracle", False), ("accelerated", True)):
         try:
-            rate, p50, p95 = bench_subprocess_cluster(
+            rate, p50, p95, _px = bench_subprocess_cluster(
                 window_s=15.0, n=4, accelerator=acc,
                 base_port=24000 if acc else 23500, warmup_s=6.0,
             )
@@ -2124,28 +2331,37 @@ def main() -> None:
             procs[label] = {"error": f"{type(err).__name__}: {err}"}
             print(f"subprocess {label} bench failed: {err}", file=sys.stderr)
 
-    # Configs 3-5 captured every round (time-budgeted).
+    # Configs 3-5 captured every round (time-budgeted). The 16-process
+    # config is the --nodes16proc comparison: threaded-JSON baseline vs
+    # the async engine on identical topology, commit-latency p50/p99
+    # scraped from the children's LIVE /metrics (docs/gossip.md).
     config3_procs = {}
-    try:
-        # 16 full interpreters on this host's ONE shared core: the config
-        # measures scheduler physics, so the load is closed-loop with a
-        # small backlog and a relaxed heartbeat to keep latency honest.
-        r3, p50_3, p95_3 = bench_subprocess_cluster(
-            window_s=15.0, heartbeat=0.1, max_backlog=100,
-        )
-        config3_procs = {
-            "txs_per_s": round(r3, 1),
-            "latency_p50_ms": p50_3,
-            "latency_p95_ms": p95_3,
-            "note": "16 interpreters share one CPU core on this host",
-        }
-        print(
-            f"config 3 (16 subprocess nodes): {r3:.1f} tx/s p50={p50_3}ms",
-            file=sys.stderr,
-        )
-    except Exception as err:
-        config3_procs = {"error": f"{type(err).__name__}: {err}"}
-        print(f"config 3 subprocess failed: {err}", file=sys.stderr)
+    for label, trans, bp in (("tcp", "tcp", 23000), ("async", "async", 26500)):
+        try:
+            # 16 full interpreters on this host's ONE shared core: the
+            # config measures scheduler physics, so the load is
+            # closed-loop with a small backlog and a relaxed heartbeat.
+            r3, p50_3, p95_3, x3 = bench_subprocess_cluster(
+                window_s=15.0, heartbeat=0.1, max_backlog=100,
+                base_port=bp, transport=trans, startup_timeout=240.0,
+            )
+            config3_procs[label] = {
+                "txs_per_s": round(r3, 1),
+                "latency_p50_ms": p50_3,
+                "latency_p95_ms": p95_3,
+                **x3,
+                "note": "16 interpreters share one CPU core on this host",
+            }
+            print(
+                f"config 3 (16 subprocess nodes, {label}): {r3:.1f} tx/s "
+                f"p50={p50_3}ms clat_p99={x3.get('clat_p99_ms')}ms "
+                f"no_fork={x3.get('no_fork')}",
+                file=sys.stderr,
+            )
+        except Exception as err:
+            config3_procs[label] = {"error": f"{type(err).__name__}: {err}"}
+            print(f"config 3 subprocess ({label}) failed: {err}",
+                  file=sys.stderr)
     config4 = {}
     try:
         r4, churn = bench_churn(window_s=12.0)
@@ -2303,6 +2519,35 @@ def main() -> None:
     else:
         extra["dag_pipeline"] = f"unavailable: {dag_err}"
 
+    # Async-engine digest (docs/gossip.md): old vs new engine tx/s on
+    # both 16-node configurations + the inflight-sync high-water mark.
+    def _ratio(new, old):
+        if not new or not old:
+            return None
+        return round(new / old, 2)
+
+    _thr_old = config3_threads.get("oracle", {}).get("txs_per_s")
+    _thr_new = config3_threads.get("async_engine", {}).get("txs_per_s")
+    _prc_old = config3_procs.get("tcp", {}).get("txs_per_s")
+    _prc_new = config3_procs.get("async", {}).get("txs_per_s")
+    gossip_block = {
+        "threads_old": _thr_old,
+        "threads_new": _thr_new,
+        "threads_ratio": _ratio(_thr_new, _thr_old),
+        "procs_old": _prc_old,
+        "procs_new": _prc_new,
+        "procs_ratio": _ratio(_prc_new, _prc_old),
+        "inflight_peak": max(
+            config3_threads.get("async_engine", {}).get(
+                "gossip_inflight_peak_max"
+            ) or 0,
+            config3_procs.get("async", {}).get("gossip_inflight_peak_max")
+            or 0,
+        ),
+        "clat_p99_ms": config3_procs.get("async", {}).get("clat_p99_ms"),
+        "no_fork": config3_procs.get("async", {}).get("no_fork"),
+    }
+
     result = {
         "metric": "committed_txs_per_s_4node",
         "value": oracle["txs_per_s"],
@@ -2347,7 +2592,13 @@ def main() -> None:
                 "cfg3_threads_accel_txs_per_s": config3_threads.get(
                     "accelerated", {}
                 ).get("txs_per_s"),
-                "cfg3_procs_txs_per_s": config3_procs.get("txs_per_s"),
+                "cfg3_procs_txs_per_s": config3_procs.get("tcp", {}).get(
+                    "txs_per_s"
+                ),
+                # Async gossip engine: old vs new engine tx/s ratios on
+                # the threaded AND multi-process 16-node configs, plus
+                # the inflight-sync high-water mark (docs/gossip.md).
+                "gossip": gossip_block,
                 "cfg4_churn_txs_per_s": config4.get("txs_per_s"),
                 "cfg5_adversarial_txs_per_s": config5.get("txs_per_s"),
                 "ingest": ingest,

@@ -8,10 +8,12 @@ app proxy; a dummy chat-app client process attaches to each. Ports:
   node i:  gossip 127.0.0.1:12000+i   service 127.0.0.1:8000+i
            proxy  127.0.0.1:13000+i   app     127.0.0.1:14000+i
 
-Usage:  python demo/testnet.py [n_nodes] [--signal] [--accelerator]
+Usage:  python demo/testnet.py [n_nodes] [--signal] [--accelerator] [--async]
 With --accelerator every node runs device consensus sweeps and the whole
 testnet shares one admission-control slot domain (co-located processes
-must not convoy their sweeps on the single device).
+must not convoy their sweeps on the single device). With --async every
+node runs the event-driven gossip engine + binary codec (docs/gossip.md)
+instead of the threaded JSON transport — mixed testnets work too.
 Stop with Ctrl-C (nodes leave politely on SIGTERM).
 """
 
@@ -35,6 +37,7 @@ def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 4
     use_signal = "--signal" in sys.argv
     accelerator = "--accelerator" in sys.argv
+    use_async = "--async" in sys.argv
     base = tempfile.mkdtemp(prefix="babble_tpu_testnet_")
     print(f"testnet dir: {base}")
 
@@ -80,6 +83,8 @@ def main() -> int:
             ]
             if use_signal:
                 cmd += ["--signal", "--signal-addr", "127.0.0.1:2443"]
+            if use_async and not use_signal:
+                cmd += ["--transport", "async"]
             if accelerator:
                 cmd.append("--accelerator")
                 os.environ.setdefault(

@@ -98,6 +98,16 @@ metricslint:
 tracesmoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m "not slow"
 
+# gossipsmoke: async gossip engine end to end — an 8-node MULTI-PROCESS
+# cluster on the event-driven transport + binary framed codec
+# (docs/gossip.md); asserts liveness (committed tx/s > 0), no-fork
+# (byte-identical block Body at a cluster-wide committed index, checked
+# over HTTP), and a populated commit-latency histogram scraped from the
+# children's live /metrics. The bench asserts internally too; this
+# re-checks the parseable summary line (the driver tail contract).
+gossipsmoke:
+	JAX_PLATFORMS=cpu python bench.py --gossip --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['txs_per_s'] > 0, d; assert d['no_fork'] is True, d; assert d['clat_samples'] > 0, d; print('gossipsmoke ok:', d['txs_per_s'], 'tx/s, clat p50', d.get('clat_p50_ms'), 'ms, inflight peak', d.get('gossip_inflight_peak_max'))"
+
 # simsmoke: deterministic virtual-time scenario sweep — 200 seeded
 # chaos x byzantine x churn x overload combinations with invariant
 # checks (no fork / liveness after heal / bounded queues / exactly-once
@@ -120,4 +130,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint tracesmoke simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint tracesmoke gossipsmoke simsmoke simsweep wheel

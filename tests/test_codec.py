@@ -1,0 +1,232 @@
+"""Binary wire codec (net/codec.py): the interop property the mixed-
+version cluster relies on — every RPC type round-trips byte-identically
+between the binary framing and the canonical-JSON framing — plus the
+blob memo and the hostile-frame guards (docs/gossip.md)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from babble_tpu.crypto.canonical import canonical_dumps
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.hashgraph.event import WireBlockSignature, WireBody, WireEvent
+from babble_tpu.hashgraph.internal_transaction import InternalTransaction
+from babble_tpu.net import codec
+from babble_tpu.net.rpc import (
+    EAGER_SYNC,
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FAST_FORWARD,
+    FastForwardRequest,
+    FastForwardResponse,
+    JOIN,
+    JoinRequest,
+    JoinResponse,
+    SYNC,
+    SyncRequest,
+    SyncResponse,
+    TYPE_OF_REQUEST,
+)
+from babble_tpu.peers.peer import Peer
+
+
+_KEYS = [generate_key() for _ in range(2)]
+
+
+def _peer(i: int) -> Peer:
+    return Peer(
+        net_addr=f"127.0.0.1:{9000 + i}",
+        pub_key_hex=_KEYS[i % len(_KEYS)].public_key.hex(),
+        moniker=f"p{i}",
+    )
+
+
+def _itx(rng: random.Random) -> InternalTransaction:
+    itx = InternalTransaction.join(_peer(rng.randrange(2)))
+    itx.sign(_KEYS[0])
+    return itx
+
+
+def _wire_event(rng: random.Random) -> WireEvent:
+    """A randomized wire event covering the field space: binary junk
+    transactions (incl. empty), negative indexes, block signatures, and
+    occasionally a signed internal transaction."""
+    txs = [
+        bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+        for _ in range(rng.randrange(0, 5))
+    ]
+    sigs = [
+        WireBlockSignature(index=rng.randrange(0, 1 << 30),
+                           signature=f"{rng.randrange(1 << 60)}|{rng.randrange(1 << 60)}")
+        for _ in range(rng.randrange(0, 3))
+    ]
+    itxs = [_itx(rng)] if rng.random() < 0.3 else []
+    return WireEvent(
+        body=WireBody(
+            transactions=txs,
+            internal_transactions=itxs,
+            block_signatures=sigs,
+            creator_id=rng.randrange(0, 1 << 32),
+            other_parent_creator_id=rng.randrange(0, 1 << 32),
+            index=rng.randrange(-1, 1 << 20),
+            self_parent_index=rng.randrange(-1, 1 << 20),
+            other_parent_index=rng.randrange(-1, 1 << 20),
+            timestamp=rng.randrange(0, 1 << 40),
+        ),
+        signature=f"{rng.randrange(1 << 64)}|{rng.randrange(1 << 64)}",
+    )
+
+
+def _trace(rng: random.Random):
+    if rng.random() < 0.5:
+        return None
+    return {
+        "id": f"{rng.randrange(1 << 32):x}-{rng.randrange(1 << 16)}",
+        "origin": rng.randrange(1 << 32),
+        "hop": rng.randrange(8),
+        "ts": rng.randrange(1 << 50),
+    }
+
+
+def _known(rng: random.Random):
+    return {
+        rng.randrange(1 << 32): rng.randrange(-1, 1 << 20)
+        for _ in range(rng.randrange(0, 8))
+    }
+
+
+def _random_request(rng: random.Random):
+    roll = rng.randrange(4)
+    if roll == 0:
+        return SyncRequest(
+            from_id=rng.randrange(1 << 32), known=_known(rng),
+            sync_limit=rng.randrange(0, 5000), trace=_trace(rng),
+        )
+    if roll == 1:
+        return EagerSyncRequest(
+            from_id=rng.randrange(1 << 32),
+            events=[_wire_event(rng) for _ in range(rng.randrange(0, 4))],
+            trace=_trace(rng),
+        )
+    if roll == 2:
+        return FastForwardRequest(
+            from_id=rng.randrange(1 << 32), trace=_trace(rng)
+        )
+    return JoinRequest(internal_transaction=_itx(rng))
+
+
+def _random_response(rng: random.Random, type_byte: int):
+    if type_byte == SYNC:
+        return SyncResponse(
+            from_id=rng.randrange(1 << 32),
+            events=[_wire_event(rng) for _ in range(rng.randrange(0, 4))],
+            known=_known(rng),
+        )
+    if type_byte == EAGER_SYNC:
+        return EagerSyncResponse(
+            from_id=rng.randrange(1 << 32), success=rng.random() < 0.5
+        )
+    if type_byte == FAST_FORWARD:
+        return FastForwardResponse(
+            from_id=rng.randrange(1 << 32),
+            snapshot=bytes(rng.randrange(256) for _ in range(16)),
+        )
+    return JoinResponse(
+        from_id=rng.randrange(1 << 32),
+        accepted=rng.random() < 0.5,
+        accepted_round=rng.randrange(1 << 20),
+        peers=[_peer(i) for i in range(rng.randrange(0, 3))],
+    )
+
+
+def _canon(msg) -> bytes:
+    """The JSON-framing encoding of a message — the byte-identity
+    yardstick for the property below."""
+    return canonical_dumps(msg.to_dict())
+
+
+def test_every_request_type_round_trips_byte_identically():
+    """Property: for every RPC request type, binary-encode → decode →
+    re-encode as canonical JSON equals the original's canonical JSON —
+    i.e. a message relayed through a binary hop is indistinguishable
+    from one that never left the JSON framing."""
+    rng = random.Random(0xC0DEC)
+    seen = set()
+    for _ in range(120):
+        req = _random_request(rng)
+        seen.add(type(req).__name__)
+        type_byte, payload = codec.encode_request(req)
+        assert type_byte == TYPE_OF_REQUEST[type(req)]
+        back = codec.decode_request(type_byte, payload)
+        assert _canon(back) == _canon(req), type(req).__name__
+    assert seen == {
+        "SyncRequest", "EagerSyncRequest", "FastForwardRequest",
+        "JoinRequest",
+    }
+
+
+def test_every_response_type_round_trips_byte_identically():
+    rng = random.Random(0xFACADE)
+    for _ in range(120):
+        type_byte = rng.randrange(4)
+        resp = _random_response(rng, type_byte)
+        payload = codec.encode_response(type_byte, resp)
+        back = codec.decode_response(type_byte, payload)
+        assert _canon(back) == _canon(resp), type(resp).__name__
+
+
+def test_event_blob_memoized_once_per_event():
+    """One event pushed to many peers costs ONE encode: the blob memo
+    on the shared WireEvent serves every later send."""
+    rng = random.Random(7)
+    we = _wire_event(rng)
+    base_encoded = codec.CODEC_STATS.events_encoded
+    base_hits = codec.CODEC_STATS.event_cache_hits
+    blob = codec.encode_wire_event(we)
+    for _ in range(15):
+        assert codec.encode_wire_event(we) is blob
+    assert codec.CODEC_STATS.events_encoded == base_encoded + 1
+    assert codec.CODEC_STATS.event_cache_hits == base_hits + 15
+    back = codec.decode_wire_event(blob)
+    assert _canon(back) == _canon(we)
+
+
+def test_truncated_event_blob_raises():
+    rng = random.Random(8)
+    blob = codec.encode_wire_event(_wire_event(rng))
+    with pytest.raises((ValueError, IndexError, Exception)):
+        codec.decode_wire_event(blob[: len(blob) // 2])
+
+
+def test_hostile_element_count_rejected():
+    """A frame claiming 2^30 events must fail fast on the count guard,
+    not allocate."""
+    import struct
+
+    payload = struct.pack(">q", 1) + struct.pack(">I", 1 << 30)
+    with pytest.raises(ValueError):
+        codec.decode_request(EAGER_SYNC, payload)
+
+
+def test_frame_header_round_trip_and_size_guard():
+    frame = codec.pack_frame(2, codec.FLAG_ERROR, 0xDEADBEEF, b"oops")
+    kind, flags, req_id, length = codec.unpack_header(frame)
+    assert (kind, flags, req_id, length) == (2, codec.FLAG_ERROR, 0xDEADBEEF, 4)
+    assert frame[codec.FRAME_HEADER.size:] == b"oops"
+    with pytest.raises(ValueError):
+        codec.pack_frame(0, 0, 1, b"x" * (codec.MAX_FRAME + 1))
+
+
+def test_hello_is_a_well_formed_legacy_frame():
+    """The negotiation probe must parse as a legacy frame (type 0xBB,
+    length 4) so an old JSON server answers it instead of dropping the
+    connection — the property mixed-version clusters depend on."""
+    import struct
+
+    assert codec.HELLO[0] == 0xBB
+    (length,) = struct.unpack(">I", codec.HELLO[1:5])
+    assert length == len(codec.HELLO) - 5 == 4
+    assert codec.HELLO[5:8] == b"BLG"
+    assert codec.HELLO[8] == codec.CODEC_VERSION
