@@ -66,6 +66,7 @@ _RUN_FLAGS = {
     "watchdog_stall": ("watchdog_stall_s", float),
     "watchdog_interval": ("watchdog_interval_s", float),
     "flight_dir": ("flight_dir", str),
+    "profile_hz": ("profile_hz", float),
     "signal": ("signal", bool),
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
@@ -360,6 +361,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--flight-dir", dest="flight_dir", default=None,
         help="directory for flight-recorder artifacts",
+    )
+    run.add_argument(
+        "--profile-hz", dest="profile_hz", type=float, default=None,
+        help="always-on sampling-profiler rate (thread-stack samples/s "
+        "served at GET /profile; 0 disables; default 50)",
     )
     run.add_argument(
         "--signal", action="store_true",

@@ -33,6 +33,12 @@ DEFAULT_TRACE_TABLE_CAP = 4096
 DEFAULT_WATCHDOG_STALL_S = 10.0
 DEFAULT_WATCHDOG_INTERVAL_S = 1.0
 
+# Always-on sampling profiler (obs/profile.py): thread-stack samples per
+# second, bucketed into the stage taxonomy and served as collapsed
+# stacks at GET /profile. 0 disables; BABBLE_PROFILE_HZ overrides a
+# whole cluster; BABBLE_OBS=0 disables regardless.
+DEFAULT_PROFILE_HZ = 50.0
+
 
 def default_data_dir() -> str:
     """~/.babble equivalent (reference: config/config.go:287-297)."""
@@ -142,6 +148,10 @@ class Config:
     watchdog_stall_s: float = DEFAULT_WATCHDOG_STALL_S
     watchdog_interval_s: float = DEFAULT_WATCHDOG_INTERVAL_S
     flight_dir: str = ""
+    # Sampling-profiler rate (obs/profile.py; docs/observability.md
+    # §Sampling profiler). One process-wide sampler serves co-located
+    # nodes; 0 disables, env BABBLE_PROFILE_HZ overrides cluster-wide.
+    profile_hz: float = DEFAULT_PROFILE_HZ
 
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory

@@ -294,6 +294,13 @@ class Node(StateManager):
             # the threaded production path arms the monitor — the sim
             # harness drives nodes without run() and calls check() itself
             self.watchdog.start()
+            # always-on sampling profiler (obs/profile.py): ONE
+            # process-wide sampler shared by co-located nodes, reading
+            # thread stacks only — safe to arm from any node, off under
+            # BABBLE_OBS=0 or profile_hz=0
+            from ..obs import profile as obs_profile
+
+            obs_profile.ensure_started(self.conf.profile_hz)
         self.control_timer.run(self.conf.heartbeat_timeout)
         bg = threading.Thread(target=self._do_background_work, daemon=True)
         bg.start()
@@ -463,6 +470,7 @@ class Node(StateManager):
                 "gossip_inflight_syncs_peak": 0,
                 "gossip_pipelined_syncs": 0,
                 "gossip_backpressure_stalls": 0,
+                "gossip_pipeline_queue_depth": 0,
             })
         from ..net.codec import CODEC_STATS
 

@@ -158,10 +158,17 @@ class SyncPipeline:
         with self._lock:
             self.inflight -= 1
 
+    def queue_depth(self) -> int:
+        """Prepared syncs waiting in the bounded insert queue right now
+        (the gossip_pipeline_queue_depth gauge — live backpressure,
+        where the stall counters only show history)."""
+        return self._q.qsize()
+
     def stats(self) -> dict:
         return {
             "gossip_inflight_syncs": self.inflight,
             "gossip_inflight_syncs_peak": self.inflight_peak,
             "gossip_pipelined_syncs": self.pipelined_syncs,
             "gossip_backpressure_stalls": self.backpressure_stalls,
+            "gossip_pipeline_queue_depth": self.queue_depth(),
         }

@@ -127,6 +127,12 @@ CATALOG: Tuple[Instrument, ...] = (
         "Pipeline submits that found the insert queue full "
         "(backpressure propagating to the transport).",
     ),
+    Instrument(
+        "gossip_pipeline_queue_depth", _G, (), "node",
+        "Prepared syncs sitting in the pipeline's bounded insert queue "
+        "RIGHT NOW (sampled at scrape; the live-backpressure twin of "
+        "the stall counters).",
+    ),
     # -- consensus progress -------------------------------------------------
     Instrument(
         "node_last_block_index", _G, (), "node",
@@ -390,6 +396,13 @@ CATALOG: Tuple[Instrument, ...] = (
         "Inbound connections that fell back to the legacy JSON framing "
         "(process-wide).",
     ),
+    Instrument(
+        "profile_stage_samples", _C, ("stage",), "global",
+        "Sampling-profiler thread-stack samples bucketed into the stage "
+        "taxonomy by frame matching (sync + accel stages plus "
+        "lock_wait, idle, other; docs/observability.md §Sampling "
+        "profiler).",
+    ),
 )
 
 BY_NAME: Dict[str, Instrument] = {i.name: i for i in CATALOG}
@@ -407,6 +420,9 @@ ACCEL_STAGES = (
     "build", "delta_scan", "pack", "dispatch", "kernel", "readback",
     "apply",
 )
+# Profiler stage buckets (obs/profile.py): the union of the two stage
+# families above plus the sampler-only buckets.
+PROFILE_STAGES = SYNC_STAGES + ACCEL_STAGES + ("lock_wait", "idle", "other")
 
 
 def spec(name: str) -> Instrument:

@@ -547,4 +547,16 @@ def wire_global() -> None:
             "Process-wide inbound connections on the legacy JSON framing.",
             lambda: CODEC_STATS.conns_json,
         )
+        # Sampling profiler (obs/profile.py): registered here — not at
+        # sampler start — so the instrument exists whether or not the
+        # profiler ever runs (the catalog's global-scope contract);
+        # it reads {} until a sampler ticks.
+        from . import profile as _profile
+
+        GLOBAL.func_counter(
+            "profile_stage_samples",
+            "Sampling-profiler thread-stack samples per stage bucket.",
+            _profile.stage_counts,
+            ("stage",),
+        )
         _global_wired = True

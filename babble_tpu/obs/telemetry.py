@@ -380,6 +380,10 @@ class NodeTelemetry:
             ),
         )
         self._func(
+            "gossip_pipeline_queue_depth",
+            lambda: node.pipeline.queue_depth() if node.pipeline else 0,
+        )
+        self._func(
             "watchdog_trips_total",
             lambda: getattr(node.watchdog, "trips", 0),
         )

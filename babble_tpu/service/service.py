@@ -5,8 +5,9 @@ endpoints /stats, /block/{index}, /blocks/{start}?count=, /graph, /peers,
 /genesispeers, /validators/{round}, /history. Extended here with the
 telemetry surface (docs/observability.md): /metrics (Prometheus text
 exposition), /telemetry (structured JSON with computed percentiles and
-recent sync traces), /mempool, /suspects, and the /debug/* routes
-(timers, thread stacks, JAX profile capture). Built on the stdlib
+recent sync traces), /mempool, /suspects, /profile (the sampling
+profiler's stage-attributed collapsed stacks; /debug/profile aliases
+it), and the /debug/* routes (timers, thread stacks). Built on the stdlib
 ThreadingHTTPServer (the reference rides http.DefaultServeMux so an
 in-process app can share the port; here an app can mount extra handlers
 via ``extra_routes``)."""
@@ -135,8 +136,14 @@ class Service:
                 body = self.node.timers.snapshot()
             elif path == "/debug/stacks":
                 body = self._thread_stacks()
-            elif path == "/debug/profile":
-                body = self._jax_profile(parse_qs(parsed.query))
+            elif path in ("/profile", "/debug/profile"):
+                # ONE profiler implementation (obs/profile.py — the
+                # always-on stage-attributed sampler); /debug/profile is
+                # the legacy alias. format=collapsed (flamegraph text,
+                # default) | cprofile (pstats-style table) | json |
+                # jax (the old device-trace capture).
+                self._profile(req, parse_qs(parsed.query))
+                return
             else:
                 self._send(req, 404, {"error": f"no route {path}"})
                 return
@@ -172,6 +179,40 @@ class Service:
             f"{names.get(tid, '?')} ({tid})": traceback.format_stack(frame)
             for tid, frame in sys._current_frames().items()
         }
+
+    def _profile(self, req: BaseHTTPRequestHandler, qs) -> None:
+        """GET /profile?seconds=N[&format=collapsed|cprofile|json|jax]:
+        a profiling window from the process sampler (docs/observability.md
+        §Sampling profiler). Bad ``seconds`` clamp to the default 3."""
+        import math
+
+        from ..obs import profile as obs_profile
+
+        fmt = qs.get("format", ["collapsed"])[0]
+        if fmt == "jax":
+            self._send(req, 200, self._jax_profile(qs))
+            return
+        try:
+            seconds = float(qs.get("seconds", ["3"])[0])
+        except ValueError:
+            seconds = 3.0
+        if not math.isfinite(seconds) or seconds <= 0:
+            seconds = 3.0
+        cap = obs_profile.capture(seconds)
+        if "error" in cap:
+            self._send(req, 503, cap)
+            return
+        if fmt == "json":
+            self._send(req, 200, cap)
+        elif fmt == "cprofile":
+            self._send_text(
+                req, 200,
+                obs_profile.cprofile_text(cap["stacks"], 1.0 / cap["hz"]),
+            )
+        else:
+            self._send_text(
+                req, 200, obs_profile.collapsed_text(cap["stacks"])
+            )
 
     _profile_lock = threading.Lock()
 

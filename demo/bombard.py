@@ -14,7 +14,9 @@ Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
 With ``--metrics``, each listed node's ``GET /metrics`` (the service's
 Prometheus endpoint, docs/observability.md) is scraped after the
 bombardment and its commit-latency p50/p90/p99 printed — the quickest
-way to see the north-star latency of a live testnet.
+way to see the north-star latency of a live testnet — followed by a
+cluster healthview summary (SLO verdict vs the 500 ms target, worst-lag
+node, per-node queue depths; obs/healthview.py).
 
 With ``--trace=K`` (requires ``--metrics`` for the service addresses),
 up to K of the submitted transactions that fall inside the cluster's
@@ -93,6 +95,34 @@ def scrape_commit_latency(endpoints: str, settle_s: float = 15.0) -> None:
             f"{ep}: commit latency n={hist['count']} "
             f"p50={1e3 * p50:.0f}ms p90={1e3 * p90:.0f}ms "
             f"p99={1e3 * p99:.0f}ms"
+        )
+
+
+def healthview_summary(endpoints: str, window_s: float = 4.0) -> None:
+    """Cluster healthview at exit (docs/observability.md §Cluster
+    healthview): SLO verdict, worst-lag node, per-node queue depths."""
+    from babble_tpu.obs import healthview
+
+    eps = [ep.strip() for ep in endpoints.split(",") if ep.strip()]
+    try:
+        view = healthview.collect(eps, window_s=window_s)
+    except Exception as err:  # noqa: BLE001 — diagnostics stay optional
+        print(f"healthview failed: {err}", file=sys.stderr)
+        return
+    print(healthview.summary_line(view))
+    for n in view["nodes"]:
+        if n.get("down"):
+            print(f"  node #{n['index']}: DOWN")
+            continue
+        q = n["queues"]
+        print(
+            f"  {n.get('moniker') or n.get('endpoint')}: lag="
+            f"{n['lag_rounds']} queues submit={q['submit']:.0f} "
+            f"pipeline={q['pipeline_inflight']:.0f}"
+            f"/{q['pipeline_queue']:.0f} "
+            f"mempool={q['mempool_pending']:.0f} "
+            f"quarantined={n['quarantined_peers']} "
+            + ("ok" if n.get("healthy") else "UNHEALTHY")
         )
 
 
@@ -268,6 +298,7 @@ def main() -> int:
         print(f"shed rate: {counts['shed'] / sent:.3f}")
     if "metrics" in opts:
         scrape_commit_latency(opts["metrics"])
+        healthview_summary(opts["metrics"])
     if "trace" in opts:
         if "metrics" not in opts:
             print("--trace needs --metrics=host:port,... for the service "

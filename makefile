@@ -82,12 +82,32 @@ byzstorm:
 # and the BABBLE_OBS=0 kill-switch overhead ratio ≥ 0.97
 # (docs/observability.md)
 obssmoke:
-	JAX_PLATFORMS=cpu python bench.py --obs --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['obs_ok'], d; assert d['commit_latency_samples'] > 0, d; assert not d['missing_metrics'], d; oh=d.get('obs_overhead',{}); r=oh.get('ratio'); assert r is None or r >= 0.97, oh; print('obssmoke ok: clat p50', d['commit_latency_p50_ms'], 'ms, overhead ratio', r)"
+	JAX_PLATFORMS=cpu python bench.py --obs --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['obs_ok'], d; assert d['commit_latency_samples'] > 0, d; assert not d['missing_metrics'], d; assert d['profile_stage_attributed'], d; oh=d.get('obs_overhead',{}); r=oh.get('ratio'); assert r is None or r >= 0.97, oh; po=d.get('profile_overhead',{}); cf=po.get('cpu_fraction'); assert cf is not None and cf < 0.02, po; assert po.get('samples_taken') is None or po['samples_taken'] > 0, po; print('obssmoke ok: clat p50', d['commit_latency_p50_ms'], 'ms, overhead ratio', r, 'profiler cpu_fraction', cf)"
 
 # metricslint: the instrument catalog and the docs table must match in
 # both directions (a new instrument cannot ship undocumented)
 metricslint:
 	python -m babble_tpu.obs.lint docs/observability.md
+
+# perfgate: the perf observatory's CI teeth (docs/observability.md
+# §Perf ledger & regression gate) — backfill the pre-ledger artifacts
+# (idempotent), run the smoke bench (appends its record to
+# BENCH_HISTORY.jsonl), gate it against the rolling same-host baseline,
+# then PROVE the gate fires: an injected 35% regression must exit
+# nonzero, else the build fails.
+perfgate:
+	python -m babble_tpu.obs.ledger --backfill
+	JAX_PLATFORMS=cpu python bench.py --smoke > /dev/null
+	python -m babble_tpu.obs.perfgate
+	@if python -m babble_tpu.obs.perfgate --inject-regression > /dev/null 2>&1; then echo "perfgate: inject-regression did NOT trip the gate"; exit 1; else echo "perfgate inject ok: gate fired on the injected regression"; fi
+
+# healthsmoke: cluster healthview end to end — a live 4-node cluster
+# with HTTP services merged over /metrics + /stats + /suspects; asserts
+# every node up and healthy, per-node lag + advance rates, and the
+# commit-p50-vs-500ms SLO scored (docs/observability.md §Cluster
+# healthview); plus the merge math + sim-export unit coverage
+healthsmoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_healthview.py -q -m "not slow"
 
 # tracesmoke: cross-node causal tracing end to end — a live 4-node TCP
 # cluster with HTTP services, every tx sampled; asserts a committed
@@ -130,4 +150,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint tracesmoke gossipsmoke simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke simsmoke simsweep wheel

@@ -66,6 +66,11 @@ def pytest_configure(config):
         "trace: cross-node causal-tracing smokes (live cluster + "
         "/trace endpoints + traceview merge; make tracesmoke)",
     )
+    config.addinivalue_line(
+        "markers",
+        "healthview: cluster-healthview smokes (live multi-node merge "
+        "over HTTP + SLO scoring; make healthsmoke)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,

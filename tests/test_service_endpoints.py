@@ -212,9 +212,44 @@ def test_debug_stacks_endpoint(cluster):
     assert any("MainThread" in k for k in stacks)
 
 
-def test_debug_profile_endpoint(cluster):
+def test_profile_endpoint_serves_stage_attributed_collapsed_stacks(cluster):
+    """The unified sampling profiler (docs/observability.md §Sampling
+    profiler): /profile returns flamegraph collapsed stacks, every
+    stack rooted at its stage bucket; /debug/profile is an alias."""
     base, *_ = cluster
-    _, text = _get(base, "/debug/profile?seconds=0.2", timeout=60.0)
+    ctype, text = _get(base, "/profile?seconds=0.5", timeout=60.0)
+    assert ctype.startswith("text/plain")
+    lines = text.strip().splitlines()
+    assert lines, "no samples in the capture window"
+    for line in lines:
+        assert line.startswith("stage:"), line
+        assert line.rsplit(" ", 1)[1].isdigit(), line
+    # alias: same implementation, same format
+    _, text2 = _get(base, "/debug/profile?seconds=0.2", timeout=60.0)
+    assert text2.strip().splitlines()[0].startswith("stage:")
+
+
+def test_profile_endpoint_cprofile_and_json_formats(cluster):
+    base, *_ = cluster
+    _, table = _get(
+        base, "/profile?seconds=0.2&format=cprofile", timeout=60.0
+    )
+    assert "sampled profile:" in table and "self_s" in table
+    _, text = _get(base, "/profile?seconds=0.2&format=json", timeout=60.0)
+    body = json.loads(text)
+    assert body["seconds"] == 0.2
+    assert body["samples"] == sum(body["stages"].values())
+    assert body["always_on"] is True  # the node armed the sampler
+
+
+def test_profile_endpoint_jax_format_keeps_device_trace(cluster):
+    base, *_ = cluster
+    # 180s: the first jax touch in this process initializes the backend
+    # inside the handler thread, which under full-suite load has blown
+    # a 60s read timeout on this shared-core host
+    _, text = _get(
+        base, "/profile?seconds=0.2&format=jax", timeout=180.0
+    )
     body = json.loads(text)
     # jax present in the test env: a real capture lands in /tmp; if the
     # profiler is unavailable the route still answers structured JSON
@@ -223,12 +258,13 @@ def test_debug_profile_endpoint(cluster):
         assert body["seconds"] == 0.2
 
 
-def test_debug_profile_rejects_bad_seconds(cluster):
+def test_profile_rejects_bad_seconds(cluster):
     base, *_ = cluster
-    _, text = _get(base, "/debug/profile?seconds=nope", timeout=60.0)
+    _, text = _get(
+        base, "/profile?seconds=nope&format=json", timeout=60.0
+    )
     body = json.loads(text)
-    if "seconds" in body:
-        assert body["seconds"] == 3.0  # clamped to the default
+    assert body["seconds"] == 3.0  # clamped to the default
 
 
 def test_graph_endpoint(cluster):

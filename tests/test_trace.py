@@ -326,6 +326,92 @@ def test_cluster_trace_merges_multi_hop_over_http():
             n.shutdown()
 
 
+# -- trace context over the binary framed codec (transport=async) ----------
+
+
+@pytest.mark.trace
+def test_trace_attribution_survives_async_binary_transport():
+    """PR 8's provenance must survive PR 9's wire: a 2-node cluster on
+    the event-driven selector transport (`--transport async`) with the
+    BINARY framed codec negotiated carries the trace context end to
+    end — the remote hop's wire/queue/insert attribution is present
+    after merge, not just on the legacy JSON framing."""
+    from babble_tpu.net.atcp import AsyncTCPTransport
+    from babble_tpu.net.codec import CODEC_STATS
+    from babble_tpu.obs import traceview
+
+    transports = [
+        AsyncTCPTransport("127.0.0.1:0", timeout=5.0) for _ in range(2)
+    ]
+    for t in transports:
+        t.listen()  # resolve ephemeral ports before the peerset
+    decoded_before = CODEC_STATS.events_decoded
+    nodes, proxies, states = _make_cluster(
+        2, transports, conf_extra={"transport": "async"}
+    )
+    try:
+        for n in nodes:
+            n.run_async()
+
+        def merged_trace(tx: bytes):
+            _wait_commit(states, tx)
+            txid = _txid(tx)
+            exports = []
+            for i, n in enumerate(nodes):
+                rec = n.get_trace(txid)
+                assert rec is not None, f"node {i} holds no record"
+                exports.append(
+                    {"node": n.get_id(), "moniker": f"t{i}",
+                     "records": [rec]}
+                )
+            m = traceview.merge_tx(txid, exports)
+            assert m is not None and m["monotone"], m
+            assert m["committed_on"] == 2
+            assert len(m["hops"]) == 1, m
+            return m
+
+        tx = b"binary-framed traced tx"
+        assert proxies[0].submit_tx(tx) == "accepted"
+        merged = merged_trace(tx)
+        assert merged["origin"] == nodes[0].get_id()
+
+        # the binary protocol actually carried events (not a silent
+        # JSON fallback), and contexts arrived over it
+        assert CODEC_STATS.events_decoded > decoded_before
+        assert CODEC_STATS.conns_binary > 0
+        assert sum(n.trace_ctx_rpcs for n in nodes) > 0
+
+        # queue/insert/consensus attribution is present on every hop
+        hop = merged["hops"][0]
+        assert hop["insert_s"] is not None and hop["insert_s"] >= 0
+        assert hop["queue_s"] is not None and hop["queue_s"] >= 0
+        assert hop["consensus_s"] is not None and hop["consensus_s"] >= 0
+
+        # WIRE attribution (send stamp from the carried context) only
+        # exists when the first arrival rode an eager push — the pull
+        # leg can win the race, so feed transactions until one hop
+        # carries it; losing it ENTIRELY would mean the binary codec
+        # dropped the context's send stamp.
+        wire_hop = hop if hop["wire_s"] is not None else None
+        deadline = time.monotonic() + 60.0
+        i = 0
+        while wire_hop is None and time.monotonic() < deadline:
+            tx_i = f"binary-framed traced tx {i}".encode()
+            i += 1
+            assert proxies[i % 2].submit_tx(tx_i) == "accepted"
+            h = merged_trace(tx_i)["hops"][0]
+            if h["wire_s"] is not None:
+                wire_hop = h
+        assert wire_hop is not None, (
+            "wire attribution lost on every tx: the binary codec "
+            "dropped the carried trace context's send stamp"
+        )
+        assert wire_hop["ctx"], "no wire context on the wire-stamped hop"
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
 # -- flight recorder --------------------------------------------------------
 
 
