@@ -51,6 +51,9 @@ _RUN_FLAGS = {
     "accelerator_mesh": ("accelerator_mesh", int),
     "transport": ("transport", str),
     "gossip_pipeline_depth": ("gossip_pipeline_depth", int),
+    "adaptive_gossip": ("adaptive_gossip", bool),  # toml only; CLI: --no-adaptive
+    "gossip_max_fanout": ("gossip_max_fanout", int),
+    "selfevent_burst": ("selfevent_burst", int),
     "mempool_max_txs": ("mempool_max_txs", int),
     "mempool_max_bytes": ("mempool_max_bytes", int),
     "mempool_overflow": ("mempool_overflow", str),
@@ -100,6 +103,10 @@ def _build_config(args: argparse.Namespace) -> Config:
         v = getattr(args, flag, None)
         if v is not None and v is not False:
             layered[attr] = v
+    # negative-polarity flag (the store_true pattern above can only turn
+    # booleans ON): --no-adaptive pins the fixed two-speed timer
+    if getattr(args, "no_adaptive", False):
+        layered["adaptive_gossip"] = False
     return Config(**layered)
 
 
@@ -297,6 +304,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--gossip-pipeline-depth", dest="gossip_pipeline_depth", type=int,
         default=None,
         help="bounded insert-queue depth of the inbound-sync pipeline",
+    )
+    run.add_argument(
+        "--no-adaptive", dest="no_adaptive", action="store_true",
+        help="disable the adaptive gossip scheduler: fixed two-speed "
+        "heartbeat, one partner per tick (same as BABBLE_ADAPT=0)",
+    )
+    run.add_argument(
+        "--gossip-max-fanout", dest="gossip_max_fanout", type=int,
+        default=None,
+        help="adaptive scheduler's fan-out ceiling: max distinct gossip "
+        "partners per tick (docs/gossip.md §Adaptive scheduling)",
+    )
+    run.add_argument(
+        "--selfevent-burst", dest="selfevent_burst", type=int, default=None,
+        help="max extra self-events coalesced per tick while the mempool "
+        "holds a full event's worth of pending txs (0 disables)",
     )
     run.add_argument(
         "--mempool-max-txs", dest="mempool_max_txs", type=int, default=None,

@@ -65,6 +65,13 @@ class ControlTimer:
             self.is_set = True
             self._cond.notify()
 
+    def poke(self) -> None:
+        """Wake a ``tick`` waiter WITHOUT a timer fire. The babble loop
+        blocks on ``tick`` (event-driven, no poll quantum); suspend and
+        shutdown call this so the loop re-checks its exit flags
+        immediately instead of waiting out the current interval."""
+        self.tick.set()
+
     def stop(self) -> None:
         with self._cond:
             self._armed = False

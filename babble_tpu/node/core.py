@@ -72,6 +72,7 @@ class Core:
         sentry: Optional[Sentry] = None,
         clock=None,
         selector_rng=None,
+        selfevent_burst: int = 0,
     ):
         # Time source (common/clock.py): event timestamps, leave-loop
         # deadlines, selector backoff, and every telemetry duration below
@@ -136,6 +137,16 @@ class Core:
         self.ingest_batch_verifies = 0
         self.ingest_batch_size_max = 0
         self.ingest_fallback_singles = 0
+
+        # Coalesced self-event minting (docs/gossip.md §Adaptive
+        # scheduling): when the mempool still holds a full event's worth
+        # of transactions after the regular per-sync/monologue
+        # self-event, mint up to ``selfevent_burst`` extra events in the
+        # SAME lock hold — a hot mempool drains at burst x event_max_txs
+        # per tick instead of one event cap per gossip round. 0 keeps
+        # the reference's one-event-per-tick shape.
+        self.selfevent_burst = max(0, int(selfevent_burst))
+        self.selfevent_coalesced = 0
 
         self.hg = Hashgraph(store, self.commit)
         self.hg.init(genesis_peers)
@@ -425,6 +436,7 @@ class Core:
         # (reference: core.go:264-270).
         if self.busy() or self.seq < 0:
             self.record_heads()
+            self.drain_hot_mempool()
 
         # One batched voting sweep per sync covers every event inserted
         # above (device path; no-op on the oracle path).
@@ -481,6 +493,33 @@ class Core:
             ev = self.heads[fid]
             self.add_self_event(ev.hex() if ev is not None else "")
             del self.heads[fid]
+
+    def drain_hot_mempool(self) -> int:
+        """Coalesced self-event minting under load: while a FULL
+        event's worth of transactions is still pending after the
+        regular self-event, mint up to ``selfevent_burst`` more (each
+        chained on our own head, like a monologue event) so the backlog
+        drains in one lock hold instead of one event cap per gossip
+        tick. Deterministic — pure function of mempool/DAG state — so
+        the sim engine replays it byte-identically. Returns the number
+        of extra events minted."""
+        minted = 0
+        cap = max(1, self.mempool.event_max_txs)
+        while (
+            minted < self.selfevent_burst
+            and self.mempool.pending_count >= cap
+        ):
+            before = self.mempool.pending_count
+            try:
+                self.add_self_event("")
+            except Exception:
+                logger.debug("coalesced self-event failed", exc_info=True)
+                break
+            if self.mempool.pending_count >= before:
+                break  # no progress (too-early guard or requeue): stop
+            minted += 1
+        self.selfevent_coalesced += minted
+        return minted
 
     def add_self_event(self, other_head: str) -> None:
         """Package the pools into a new head event

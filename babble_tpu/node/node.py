@@ -90,6 +90,7 @@ class Node(StateManager):
             sentry=Sentry.from_config(conf),
             clock=self.clock,
             selector_rng=selector_rng,
+            selfevent_burst=conf.selfevent_burst,
         )
         # Equivocation proofs persist through the store's evidence table
         # (and load back on restart) when the store supports it.
@@ -135,6 +136,11 @@ class Node(StateManager):
         # the pull response) — a hostile peer must not dictate how much
         # we ingest per request.
         self.sync_limit_truncations = 0
+        # Sender-side twin: OUR diff exceeded sync_limit and was cut
+        # before the push. A peer that chronically trails by more than
+        # one sync_limit shows up here — silent truncation was how the
+        # lag hid (ISSUE 11 satellite).
+        self.sync_diff_truncations = 0
         # Outbound gossip rounds lost to TransportErrors — the network-
         # fault counter the chaos soaks assert on (rpc_errors_* counts
         # handler crashes, this counts the wire).
@@ -171,8 +177,45 @@ class Node(StateManager):
         self._prewarm_thread = None
         # Cap overlapping gossip rounds: unbounded overlap just piles
         # threads onto core_lock under the GIL (the Go reference relies on
-        # cheap goroutines; here 2 in flight keeps the pipeline full).
-        self._gossip_slots = threading.Semaphore(2)
+        # cheap goroutines; here a small in-flight cap keeps the pipeline
+        # full). Sized for the adaptive fan-out: a full-fan tick plus one
+        # straggler from the previous tick.
+        self._gossip_slot_cap = max(2, conf.gossip_max_fanout + 1)
+        self._gossip_slots = threading.Semaphore(self._gossip_slot_cap)
+        # Rounds currently occupying a slot, and the tick-start snapshot
+        # of it (rounds still running FROM THE PREVIOUS tick) — the
+        # adaptive controller's "our own gossip is overrunning the
+        # cadence" congestion signal. The snapshot, not the live value:
+        # sampled right after a fan-out spawn the live count is trivially
+        # high and would brake a perfectly healthy node.
+        self._gossip_rounds_inflight = 0
+        self._rounds_carryover = 0
+        self._rounds_lock = threading.Lock()
+        # Adaptive gossip scheduler (node/adaptive.py, docs/gossip.md
+        # §Adaptive scheduling): maps live load signals to the next
+        # tick's interval / fan-out / pipeline soft depth. None (the
+        # BABBLE_ADAPT=0 kill switch or adaptive_gossip=false) falls
+        # back to the fixed two-speed heartbeat, bit for bit.
+        self.adaptive = None
+        if conf.adaptive_gossip:
+            from .adaptive import AdaptiveGossipController
+
+            self.adaptive = AdaptiveGossipController.from_config(conf)
+        self._plan_lock = threading.Lock()
+        self._fanout = 1
+        # Last stateful controller fold (monotonic): _reset_timer runs
+        # after EVERY handled RPC, and each fold moves the EWMAs — so
+        # folds are rate-limited to one per fast-rail interval, or an
+        # RPC burst would collapse the smoothing exactly when it
+        # matters. Between folds the published plan is reused.
+        self._last_plan_t = float("-inf")
+        # Per-peer lag from exchanged known-maps (healthview's
+        # advance-rate idea moved into the node): how many events each
+        # peer trails us by, and how many we trail them by — the
+        # adaptive controller's spread/tempo signals.
+        self._lag_lock = threading.Lock()
+        self._peer_behind: Dict[int, int] = {}
+        self._self_behind: Dict[int, int] = {}
         # Inbound-sync pipeline (node/pipeline.py): decode+batch-verify
         # overlap across handler threads, the insert tail drains through
         # one serialized inserter, bounded queue backpressures the
@@ -357,6 +400,9 @@ class Node(StateManager):
             self.logger.info("SUSPEND")
             self._transition(State.SUSPENDED)
             self.suspend_event.set()
+            # the babble loop blocks on the tick event (no poll): wake
+            # it so the suspend is observed now, not next heartbeat
+            self.control_timer.poke()
             self.wait_routines(timeout=2.0)
 
     # -- getters ------------------------------------------------------------
@@ -460,6 +506,29 @@ class Node(StateManager):
         stats["flight_dumps"] = self.watchdog.dumps
         stats.update(self.core.peer_selector.stats())
         stats["sync_limit_truncations"] = self.sync_limit_truncations
+        stats["sync_diff_truncations"] = self.sync_diff_truncations
+        # Adaptive gossip scheduler surface (docs/gossip.md §Adaptive
+        # scheduling): the controller's published plan + change count,
+        # coalesced self-event minting, and the per-peer lag extremes
+        # feeding the law. With adaptation off the fixed two-speed law
+        # is reported in the same keys so dashboards need no branches.
+        if self.adaptive is not None:
+            stats.update(self.adaptive.stats())
+        else:
+            # gossip_plan IS the fixed two-speed law (and is
+            # side-effect-free) when the controller is off
+            interval, fanout = self.gossip_plan()
+            stats.update({
+                "adaptive_interval_ms": round(1e3 * interval, 3),
+                "adaptive_fanout": fanout,
+                "adaptive_soft_depth": self.conf.gossip_pipeline_depth,
+                "adaptive_ticks": 0,
+                "adaptive_adjustments": 0,
+            })
+        peer_behind, self_behind = self._lag_extremes()
+        stats["gossip_peer_behind_max"] = peer_behind
+        stats["gossip_self_behind_max"] = self_behind
+        stats["selfevent_coalesced"] = self.core.selfevent_coalesced
         # Async gossip engine surface (docs/gossip.md): inbound-sync
         # pipeline occupancy + the process-wide binary codec tallies.
         if self.pipeline is not None:
@@ -469,8 +538,10 @@ class Node(StateManager):
                 "gossip_inflight_syncs": 0,
                 "gossip_inflight_syncs_peak": 0,
                 "gossip_pipelined_syncs": 0,
+                "gossip_pull_pipelined_syncs": 0,
                 "gossip_backpressure_stalls": 0,
                 "gossip_pipeline_queue_depth": 0,
+                "gossip_pipeline_soft_depth": self.conf.gossip_pipeline_depth,
             })
         from ..net.codec import CODEC_STATS
 
@@ -534,20 +605,97 @@ class Node(StateManager):
                 self._reset_timer()
 
     def _reset_timer(self) -> None:
-        """reference: node.go:365-379.
+        """reference: node.go:365-379 — interval now chosen by
+        :meth:`gossip_plan` (adaptive controller, or the reference's
+        fixed two-speed law when adaptation is off).
 
-        busy() is a snapshot read of plain attributes (pool lengths,
-        pending counters) — taking the core lock for it only added
-        contention on the insert pipeline; a momentarily stale heartbeat
+        The signals read are snapshot reads of plain attributes (pool
+        lengths, pending counters) — taking the core lock for them only
+        added contention on the insert pipeline; a momentarily stale
         choice is harmless (the next tick re-reads)."""
         if not self.control_timer.is_set:
-            busy = self.core.busy()
-            ts = (
+            interval, _ = self.gossip_plan()
+            self.control_timer.reset(interval)
+
+    def gossip_plan(self) -> tuple:
+        """(interval_s, fanout) for the next gossip tick. With the
+        adaptive controller on, one signal snapshot is folded into the
+        control law (EWMA + hysteresis, node/adaptive.py) and the
+        pipeline's soft depth cap is re-published; with it off, the
+        reference's fixed law: heartbeat when busy, slow heartbeat when
+        idle, one partner per tick."""
+        busy = self.core.busy()
+        if self.adaptive is None:
+            interval = (
                 self.conf.heartbeat_timeout
                 if busy
                 else self.conf.slow_heartbeat_timeout
             )
-            self.control_timer.reset(ts)
+            return interval, 1
+        from .adaptive import GossipSignals
+
+        peer_behind, self_behind = self._lag_extremes()
+        sig = GossipSignals(
+            busy=busy,
+            mempool_pending=self.core.mempool.pending_count,
+            inflight=self.pipeline.inflight if self.pipeline else 0,
+            queue_depth=(
+                self.pipeline.queue_depth() if self.pipeline else 0
+            ),
+            peer_behind=peer_behind,
+            self_behind=self_behind,
+            rounds_inflight=self._rounds_carryover,
+            rounds_cap=self._gossip_slot_cap,
+        )
+        with self._plan_lock:
+            now = self.clock.monotonic()
+            if now - self._last_plan_t >= self.adaptive.fast_s:
+                plan = self.adaptive.update(sig)
+                self._last_plan_t = now
+            else:
+                # mid-interval caller (an RPC-handler _reset_timer):
+                # reuse the published plan, don't re-fold the EWMAs
+                plan = self.adaptive.current()
+            self._fanout = plan.fanout
+        if self.pipeline is not None:
+            self.pipeline.set_soft_depth(plan.soft_depth)
+        return plan.interval, plan.fanout
+
+    # -- per-peer lag (adaptive signals) ------------------------------------
+
+    def _note_peer_known(
+        self, peer_id: int, ours: Dict[int, int], theirs: Dict[int, int]
+    ) -> None:
+        """Fold one exchanged known-map pair into the per-peer lag view:
+        total events the peer is missing that we hold (``peer_behind``)
+        and vice versa (``self_behind``). Called from both gossip legs,
+        so every contact refreshes its partner's entry."""
+        peer_behind = 0
+        self_behind = 0
+        for cid, our_idx in ours.items():
+            their_idx = theirs.get(cid, -1)
+            if our_idx > their_idx:
+                peer_behind += our_idx - their_idx
+        for cid, their_idx in theirs.items():
+            if their_idx > ours.get(cid, -1):
+                self_behind += their_idx - ours.get(cid, -1)
+        with self._lag_lock:
+            self._peer_behind[peer_id] = peer_behind
+            self._self_behind[peer_id] = self_behind
+
+    def _lag_extremes(self) -> tuple:
+        """(max events any peer trails us by, max events we trail any
+        peer by) over the last contact with each CURRENT peer — entries
+        for since-removed peers are ignored (and dropped), so a departed
+        laggard can't pin the fan-out open forever."""
+        live = {p.id for p in self.core.peer_selector.get_peers().peers}
+        with self._lag_lock:
+            for d in (self._peer_behind, self._self_behind):
+                for pid in [k for k in d if k not in live]:
+                    del d[pid]
+            peer_behind = max(self._peer_behind.values(), default=0)
+            self_behind = max(self._self_behind.values(), default=0)
+        return peer_behind, self_behind
 
     def _check_suspend(self) -> None:
         """Auto-suspend on runaway undetermined events or eviction
@@ -571,7 +719,15 @@ class Node(StateManager):
     # -- babbling -----------------------------------------------------------
 
     def _babble(self, gossip: bool) -> None:
-        """Gossip or monologue on each timer tick (reference: node.go:416-443)."""
+        """Gossip on each timer tick (reference: node.go:416-443).
+
+        The wait is EVENT-driven: the loop blocks on the tick event
+        itself (suspend/shutdown poke it, so exits stay prompt) instead
+        of the old 100 ms polling wait, which both burned a core and
+        floored the achievable gossip interval at the poll quantum —
+        the adaptive controller's fast rail is the heartbeat itself,
+        not heartbeat-rounded-up-to-100ms. The long timeout below is a
+        lost-wakeup guard only, never the cadence."""
         self.logger.info("BABBLING")
         self.suspend_event.clear()
         while True:
@@ -579,26 +735,42 @@ class Node(StateManager):
                 return
             if self.get_state() != State.BABBLING:
                 return
-            if self.control_timer.tick.wait(timeout=0.1):
+            if self.control_timer.tick.wait(timeout=5.0):
+                if (
+                    self.shutdown_event.is_set()
+                    or self.suspend_event.is_set()
+                ):
+                    self.control_timer.tick.clear()
+                    return
                 self.control_timer.tick.clear()
+                # rounds still running from the previous tick = the
+                # cadence is overrunning the host (adaptive congestion)
+                self._rounds_carryover = self._gossip_rounds_inflight
                 if gossip:
-                    peer = self.core.peer_selector.next()
-                    if peer is not None:
-                        if self._gossip_slots.acquire(blocking=False):
+                    peers = self.core.peer_selector.next_many(self._fanout)
+                    if peers:
+                        for peer in peers:
+                            if not self._gossip_slots.acquire(blocking=False):
+                                break  # fan the rest next tick
                             started = self.go_func(
                                 lambda p=peer: self._gossip_with_slot(p)
                             )
                             if not started:
                                 self._gossip_slots.release()
+                                break
                     else:
                         self._monologue()
                 self._reset_timer()
                 self._check_suspend()
 
     def _gossip_with_slot(self, peer: Peer) -> None:
+        with self._rounds_lock:
+            self._gossip_rounds_inflight += 1
         try:
             self._gossip(peer)
         finally:
+            with self._rounds_lock:
+                self._gossip_rounds_inflight -= 1
             self._gossip_slots.release()
 
     def _monologue(self) -> None:
@@ -606,6 +778,7 @@ class Node(StateManager):
         with self.core_lock:
             if self.core.busy():
                 self.core.add_self_event("")
+                self.core.drain_hot_mempool()
                 self.core.hg.flush_consensus()
                 self.core.process_sig_pool()
 
@@ -649,7 +822,15 @@ class Node(StateManager):
             )
 
     def _pull(self, peer: Peer) -> Dict[int, int]:
-        """SyncRequest leg (reference: node.go:504-538)."""
+        """SyncRequest leg (reference: node.go:504-538).
+
+        With the staged pipeline on, the pulled events go through the
+        SAME decode→batch-verify→bounded-queue→single-inserter staging
+        as inbound eager syncs (node/pipeline.py): stage 1 runs here in
+        the gossip thread (lock-free), the insert tail drains on the
+        inserter — so a slow insert never blocks this round's push leg
+        or the next pull round-trip. Inline fallback (pipeline off, sim
+        clock, or stopped) keeps the pre-pipeline shape."""
         with self.core_lock:
             known = self.core.known_events()
         t0 = self.clock.monotonic()
@@ -661,6 +842,7 @@ class Node(StateManager):
         dt = self.clock.monotonic() - t0
         self.timers.record("request_sync", dt)
         self.telemetry.observe_stage("request_sync", dt)
+        self._note_peer_known(peer.id, known, resp.known)
         if len(resp.events) > self.conf.sync_limit:
             # We asked for at most sync_limit events; a bigger response
             # means the peer ignored the negotiated cap.
@@ -668,15 +850,20 @@ class Node(StateManager):
             self.sync_limit_truncations += 1
             self.core.sentry.record(peer.id, "oversized_sync")
         t0 = self.clock.monotonic()
+        hop = {"from": peer.id, "recv": recv}
+        if (
+            self.pipeline is not None
+            and resp.events
+            and self.pipeline.submit_pull(peer.id, resp.events, hop)
+        ):
+            self.timers.record("sync", self.clock.monotonic() - t0)
+            return resp.known
         # Lock-free ingest stage: decode + hash + one batch signature
         # verification happen BEFORE the core lock; the lock then only
         # covers the ordered insert + DivideRounds sweep.
         prepared = self.core.prepare_sync(resp.events)
         with self.core_lock:
-            self._sync(
-                peer.id, resp.events, prepared,
-                hop={"from": peer.id, "recv": recv},
-            )
+            self._sync(peer.id, resp.events, prepared, hop=hop)
         self.timers.record("sync", self.clock.monotonic() - t0)
         return resp.known
 
@@ -691,7 +878,12 @@ class Node(StateManager):
         if not diff:
             return
         if len(diff) > self.conf.sync_limit:
+            # Sender-side truncation is no longer silent: the counter is
+            # the receiving side's sync_limit_truncations twin, so a
+            # peer chronically more than one sync_limit behind us is
+            # visible in get_stats//metrics instead of just staying lag.
             diff = diff[: self.conf.sync_limit]
+            self.sync_diff_truncations += 1
         wire = self.core.to_wire(diff)
         t0 = self.clock.monotonic()
         self._request_eager_sync(peer.net_addr, wire)
@@ -930,6 +1122,9 @@ class Node(StateManager):
             resp.events = self.core.to_wire(diff)
             with self.core_lock:
                 resp.known = self.core.known_events()
+            # the requester told us what it knows: refresh its lag entry
+            # (adaptive spread signal) without waiting for our own pull
+            self._note_peer_known(cmd.from_id, resp.known, cmd.known)
         except Exception as e:
             self.sync_errors += 1
             self.rpc_errors["sync"] += 1
@@ -1013,6 +1208,40 @@ class Node(StateManager):
             )
             err = str(e)
         rpc.respond(EagerSyncResponse(self.get_id(), success), err)
+
+    def _fail_pulled_sync(self, from_id: int, e: Exception) -> None:
+        """Insert-tail failure of a pulled batch on the inserter thread
+        (stage-1 failures propagate out of submit_pull to _gossip's own
+        handler instead) — same attribution as the inline pull leg:
+        classified hashgraph rejections score the serving peer through
+        the sentry; anything else is a local error and only gets
+        logged."""
+        cause = self.core.sentry.observe_rejection(e, from_id)
+        if cause is not None:
+            self.logger.warning(
+                "gossip rejection from %d (%s): %s", from_id, cause, e
+            )
+        else:
+            self.logger.warning("pulled-sync error: %s", e)
+
+    def _finish_pulled_sync(self, from_id: int, events: List[WireEvent],
+                            prepared, hop: Optional[dict]) -> None:
+        """Insert tail of one pulled batch. Called by the pipeline's
+        inserter thread (or inline on the queue-full backpressure path);
+        ``prepared`` is the lock-free stage's output for ``events``.
+        There is no RPC to answer. A rejection here lands AFTER the
+        gossip round already recorded the contact (the round's success
+        is the wire exchange; the staged insert is deliberately off its
+        critical path), so the feedback channel for a peer serving bad
+        payloads is the sentry — repeated classified rejections
+        quarantine it, which the selector hard-excludes — matching the
+        inline path's real defense (insert rejections never decayed
+        selector health there either; only transport failures do)."""
+        try:
+            with self.core_lock:
+                self._sync(from_id, events, prepared, hop)
+        except Exception as e:
+            self._fail_pulled_sync(from_id, e)
 
     def _process_fast_forward_request(
         self, rpc: RPC, cmd: FastForwardRequest

@@ -180,79 +180,126 @@ class RandomPeerSelector:
 
     def next(self) -> Optional[Peer]:
         """reference: peer_selector.go:80-103, health-weighted."""
-        now = self._clock()
         with self._lock:
-            ids = list(self._selectable.keys())
-            if not ids:
-                return None
-            if self._quarantine_check is not None:
-                # Quarantined peers are hard-excluded (no probe trickle)
-                # while ANY non-quarantined peer exists — but with the
-                # same liveness floor as the backoff path: an
-                # all-quarantined view means framing (the sentry caps
-                # honest quarantines at the BFT f bound) or gross
-                # misconfiguration, and gossip must keep trying SOMEONE.
-                open_ids = [i for i in ids if not self._quarantine_check(i)]
-                if len(open_ids) < len(ids):
-                    self.quarantine_skips += 1
-                if not open_ids:
-                    self.quarantine_overrides += 1
-                else:
-                    ids = open_ids
-            if len(ids) == 1:
-                return self._selectable[ids[0]]
-            candidates = [i for i in ids if i != self.last] or ids
+            exclude = {self.last} if self.last is not None else set()
+            return self._pick_locked(self._clock(), exclude)
 
-            # due probes first: a failing peer whose backoff expired gets
-            # deterministically re-tried (never starved, heals promptly).
-            # Most-overdue first, so several failing peers share the probe
-            # budget round-robin instead of the first-in-map monopolizing.
-            due = [
-                pid
-                for pid in candidates
-                if self._health[pid].failures > 0
-                and self._health[pid].blocked_until <= now
-                and 0.0 < self._health[pid].next_probe <= now
-            ]
-            if due:
-                pid = min(due, key=lambda i: self._health[i].next_probe)
-                h = self._health[pid]
-                h.next_probe = now + self.probe_interval_s
-                h.probes += 1
-                self.probe_picks += 1
-                return self._selectable[pid]
+    def next_many(self, k: int) -> List[Peer]:
+        """Up to ``k`` DISTINCT gossip partners for one fan-out tick
+        (adaptive scheduler, docs/gossip.md §Adaptive scheduling). Each
+        pick runs the same health-weighted law as :meth:`next` with the
+        already-chosen peers excluded; the list stops early when no
+        further distinct candidate exists, so ``k`` larger than the
+        peer set degrades gracefully."""
+        picked: List[Peer] = []
+        never: set = set()
+        with self._lock:
+            now = self._clock()
+            avoid = {self.last} if self.last is not None else set()
+            for _ in range(max(1, k)):
+                # snapshot the skip/override counters: a pick the dup
+                # check below discards must not inflate the operator
+                # alarms (quarantine/starvation overrides) fanout-fold
+                before = (
+                    self.backoff_skips, self.probe_picks,
+                    self.starvation_overrides, self.quarantine_skips,
+                    self.quarantine_overrides,
+                )
+                p = self._pick_locked(now, avoid, never)
+                if p is None or any(q.id == p.id for q in picked):
+                    # exhausted, or a liveness override re-served a peer
+                    # already chosen this tick — fan-out never doubles up
+                    (self.backoff_skips, self.probe_picks,
+                     self.starvation_overrides, self.quarantine_skips,
+                     self.quarantine_overrides) = before
+                    break
+                picked.append(p)
+                never = never | {p.id}
+        return picked
 
+    def _pick_locked(
+        self, now: float, avoid: set, never: set = frozenset()
+    ) -> Optional[Peer]:
+        """One health-weighted pick; callers hold the selector lock.
+        ``avoid`` peers (the reference's last-contacted exclusion) are
+        skipped while alternatives exist but re-admitted when nothing
+        else remains; ``never`` peers (fan-out's already-picked set) are
+        only re-served on the final everyone-excluded fallback, which
+        the caller's duplicate check turns into a stop — so a fan-out
+        tick fills from every distinct candidate, including ``last``,
+        before giving up."""
+        ids = list(self._selectable.keys())
+        if not ids:
+            return None
+        if self._quarantine_check is not None:
+            # Quarantined peers are hard-excluded (no probe trickle)
+            # while ANY non-quarantined peer exists — but with the
+            # same liveness floor as the backoff path: an
+            # all-quarantined view means framing (the sentry caps
+            # honest quarantines at the BFT f bound) or gross
+            # misconfiguration, and gossip must keep trying SOMEONE.
+            open_ids = [i for i in ids if not self._quarantine_check(i)]
+            if len(open_ids) < len(ids):
+                self.quarantine_skips += 1
+            if not open_ids:
+                self.quarantine_overrides += 1
+            else:
+                ids = open_ids
+        if len(ids) == 1:
+            return self._selectable[ids[0]]
+        pool = [i for i in ids if i not in never] or ids
+        candidates = [i for i in pool if i not in avoid] or pool
+
+        # due probes first: a failing peer whose backoff expired gets
+        # deterministically re-tried (never starved, heals promptly).
+        # Most-overdue first, so several failing peers share the probe
+        # budget round-robin instead of the first-in-map monopolizing.
+        due = [
+            pid
+            for pid in candidates
+            if self._health[pid].failures > 0
+            and self._health[pid].blocked_until <= now
+            and 0.0 < self._health[pid].next_probe <= now
+        ]
+        if due:
+            pid = min(due, key=lambda i: self._health[i].next_probe)
+            h = self._health[pid]
+            h.next_probe = now + self.probe_interval_s
+            h.probes += 1
+            self.probe_picks += 1
+            return self._selectable[pid]
+
+        open_ids = [
+            i for i in candidates if self._health[i].blocked_until <= now
+        ]
+        if len(open_ids) < len(candidates):
+            self.backoff_skips += 1
+        if not open_ids:
+            # every non-avoided candidate is backed off. Before
+            # resurrecting a backed-off (likely dead) peer, re-admit
+            # the avoided ones if THEY are healthy — re-gossiping a
+            # known-good peer beats burning a round on a known-bad one.
             open_ids = [
-                i for i in candidates if self._health[i].blocked_until <= now
+                i for i in pool if self._health[i].blocked_until <= now
             ]
-            if len(open_ids) < len(candidates):
-                self.backoff_skips += 1
-            if not open_ids:
-                # every non-last candidate is backed off. Before
-                # resurrecting a backed-off (likely dead) peer, re-admit
-                # the last-contacted one if IT is healthy — re-gossiping a
-                # known-good peer beats burning a round on a known-bad one.
-                open_ids = [
-                    i for i in ids if self._health[i].blocked_until <= now
-                ]
-            if not open_ids:
-                # truly everyone is backed off: pick the one whose backoff
-                # expires first — gossip must keep trying SOMEONE
-                self.starvation_overrides += 1
-                return self._selectable[
-                    min(ids, key=lambda i: self._health[i].blocked_until)
-                ]
-            weights = [self._health[i].score for i in open_ids]
-            total = sum(weights)
-            if total <= 0.0:
-                return self._selectable[self._rng.choice(open_ids)]
-            roll = self._rng.random() * total
-            acc = 0.0
-            for pid, w in zip(open_ids, weights):
-                acc += w
-                if roll <= acc:
-                    return self._selectable[pid]
-            return self._selectable[open_ids[-1]]
+        if not open_ids:
+            # truly everyone is backed off: pick the one whose backoff
+            # expires first — gossip must keep trying SOMEONE
+            self.starvation_overrides += 1
+            return self._selectable[
+                min(pool, key=lambda i: self._health[i].blocked_until)
+            ]
+        weights = [self._health[i].score for i in open_ids]
+        total = sum(weights)
+        if total <= 0.0:
+            return self._selectable[self._rng.choice(open_ids)]
+        roll = self._rng.random() * total
+        acc = 0.0
+        for pid, w in zip(open_ids, weights):
+            acc += w
+            if roll <= acc:
+                return self._selectable[pid]
+        return self._selectable[open_ids[-1]]
 
     # -- observability ---------------------------------------------------
 

@@ -103,6 +103,12 @@ CATALOG: Tuple[Instrument, ...] = (
         "cap).",
     ),
     Instrument(
+        "sync_diff_truncations_total", _C, (), "node",
+        "Outbound push diffs cut to sync_limit before sending "
+        "(sender-side cap) — a chronically-truncating peer is more than "
+        "one sync_limit behind us.",
+    ),
+    Instrument(
         "submit_queue_depth", _G, (), "node",
         "Transactions sitting in the proxy submit queue (sampled at "
         "scrape).",
@@ -132,6 +138,48 @@ CATALOG: Tuple[Instrument, ...] = (
         "Prepared syncs sitting in the pipeline's bounded insert queue "
         "RIGHT NOW (sampled at scrape; the live-backpressure twin of "
         "the stall counters).",
+    ),
+    Instrument(
+        "gossip_pull_pipelined_total", _C, (), "node",
+        "Gossip pull legs whose insert tail went through the staged "
+        "pipeline instead of running on the gossip thread.",
+    ),
+    Instrument(
+        "gossip_pipeline_soft_depth", _G, (), "node",
+        "Adaptive soft cap on the pipeline's insert queue: submits "
+        "backpressure at this depth (shrinks under ingest congestion; "
+        "equals the hard depth when uncongested).",
+    ),
+    # -- adaptive gossip scheduler (docs/gossip.md §Adaptive scheduling) ----
+    Instrument(
+        "adaptive_interval_seconds", _G, (), "node",
+        "Gossip interval currently published by the adaptive scheduler "
+        "(the fixed two-speed choice when adaptation is off).",
+    ),
+    Instrument(
+        "adaptive_fanout", _G, (), "node",
+        "Distinct gossip partners per tick currently published by the "
+        "adaptive scheduler (1 when adaptation is off).",
+    ),
+    Instrument(
+        "adaptive_adjustments_total", _C, (), "node",
+        "Times the adaptive scheduler re-published interval, fan-out, "
+        "or pipeline soft depth (hysteresis-gated output changes).",
+    ),
+    Instrument(
+        "gossip_peer_behind_max", _G, (), "node",
+        "Max events any peer trails US by, from the last exchanged "
+        "known-maps (the adaptive spread signal).",
+    ),
+    Instrument(
+        "gossip_self_behind_max", _G, (), "node",
+        "Max events WE trail any peer by, from the last exchanged "
+        "known-maps (the adaptive tempo signal).",
+    ),
+    Instrument(
+        "selfevent_coalesced_total", _C, (), "node",
+        "Extra self-events minted by hot-mempool coalescing (beyond the "
+        "reference's one per tick).",
     ),
     # -- consensus progress -------------------------------------------------
     Instrument(

@@ -146,9 +146,27 @@ def gate(current: dict, baseline: List[dict],
     }
 
 
-def inject_regression(current: dict, factor: float) -> dict:
+def inject_regression(current: dict, factor: float,
+                      baseline: Optional[List[dict]] = None,
+                      tolerance: float = DEFAULT_TOLERANCE) -> dict:
     """A synthetic regressed clone of ``current``: every gated metric
-    degraded by ``factor`` in its bad direction (the gate self-proof)."""
+    degraded by ``factor`` in its bad direction (the gate self-proof).
+
+    The degradation anchors on the metric's BASELINE MEDIAN when a
+    baseline is given, not on the current value: after a genuine
+    bigger-than-``factor`` improvement, degrading the current run alone
+    still beats the old median and the proof would falsely report a
+    toothless gate. And it degrades by at least 2.2x the metric's OWN
+    noise band (the gate's hard-regression threshold is 2x): right
+    after a perf jump the window is bimodal and the MAD-widened band
+    legitimately exceeds any fixed factor — the proof's claim is "the
+    gate fires on a beyond-band regression", so the injection must be
+    beyond the band the gate will actually apply. Metrics with no
+    history fall back to the current value (they are ungated anyway)."""
+    history: Dict[str, List[float]] = {}
+    for rec in baseline or ():
+        for name, (value, _unit) in ledger.results_map(rec).items():
+            history.setdefault(name, []).append(value)
     bad = json.loads(json.dumps(current))
     bad["source"] = f"inject-regression:{factor}"
     for row in bad.get("results", ()):
@@ -157,10 +175,21 @@ def inject_regression(current: dict, factor: float) -> dict:
         except (KeyError, TypeError, ValueError):
             continue
         direc = direction(str(name), str(unit))
+        vals = history.get(str(name))
+        if vals:
+            anchor = _median(vals)
+            noise = _mad(vals, anchor) / abs(anchor) if anchor else 0.0
+            # the SAME band formula gate() will apply — including the
+            # caller's --tolerance, or a widened band makes the proof
+            # falsely report a toothless gate
+            band = max(tolerance, NOISE_MULT * noise)
+            degrade = max(factor, 2.2 * band)
+        else:
+            anchor, degrade = value, factor
         if direc == "higher":
-            row["value"] = round(value * (1.0 - factor), 6)
+            row["value"] = round(anchor * (1.0 - degrade), 6)
         elif direc == "lower":
-            row["value"] = round(value * (1.0 + factor), 6)
+            row["value"] = round(anchor * (1.0 + degrade), 6)
     return bad
 
 
@@ -255,11 +284,14 @@ def main(argv=None) -> int:
     if args.inject_regression:
         # Baseline for the synthetic record includes the REAL latest run
         # (that is the history the regression would land on); a window
-        # of one genuine run is enough for the proof.
-        bad = inject_regression(current, args.inject_factor)
-        baseline = baseline_for(records + [bad], bad, args.window)
+        # of one genuine run is enough for the proof. Built BEFORE the
+        # injection so the clone can degrade from the baseline medians.
+        probe = {"host": current.get("host", {}), "run": current.get("run")}
+        baseline = baseline_for(records, probe, args.window)
         # the real latest run always corroborates its own clone's gate
         baseline = baseline or [current]
+        bad = inject_regression(current, args.inject_factor, baseline,
+                                tolerance=args.tolerance)
         verdict = gate(bad, baseline, args.tolerance, args.strict)
         current = bad
         _emit(verdict, current, args.as_json)

@@ -346,6 +346,10 @@ class NodeTelemetry:
             "sync_limit_truncations_total",
             lambda: node.sync_limit_truncations,
         )
+        self._func(
+            "sync_diff_truncations_total",
+            lambda: node.sync_diff_truncations,
+        )
         self._func("submit_queue_depth", lambda: node.submit_q.qsize())
         self._func(
             "core_lock_wait_seconds_total",
@@ -382,6 +386,66 @@ class NodeTelemetry:
         self._func(
             "gossip_pipeline_queue_depth",
             lambda: node.pipeline.queue_depth() if node.pipeline else 0,
+        )
+        self._func(
+            "gossip_pull_pipelined_total",
+            lambda: node.pipeline.pull_pipelined if node.pipeline else 0,
+        )
+        self._func(
+            "gossip_pipeline_soft_depth",
+            lambda: (
+                node.pipeline.soft_depth
+                if node.pipeline
+                else node.conf.gossip_pipeline_depth
+            ),
+        )
+        # Adaptive gossip scheduler (docs/gossip.md §Adaptive
+        # scheduling): the published plan, its change count, and the
+        # per-peer lag extremes feeding the control law. With the
+        # controller off the gauges read the fixed law's choices.
+        self._func(
+            "adaptive_interval_seconds",
+            lambda: (
+                node.adaptive.current().interval
+                if node.adaptive is not None
+                # gossip_plan IS the fixed law (pure) with the
+                # controller off — one implementation, no drift
+                else node.gossip_plan()[0]
+            ),
+        )
+        self._func(
+            "adaptive_fanout",
+            lambda: (
+                node.adaptive.current().fanout
+                if node.adaptive is not None
+                else 1
+            ),
+        )
+        self._func(
+            "adaptive_adjustments_total",
+            lambda: (
+                node.adaptive.adjustments
+                if node.adaptive is not None
+                else 0
+            ),
+        )
+        # One lag sweep serves both gauges within a collect pass (the
+        # sweep takes the selector + lag locks and prunes stale
+        # entries — same short-TTL memo shape as the selector gauges).
+        lag_memo = {"t": -1.0, "v": (0, 0)}
+
+        def _lag():
+            now = time.monotonic()
+            if lag_memo["t"] < 0 or now - lag_memo["t"] > 0.05:
+                lag_memo["v"] = node._lag_extremes()
+                lag_memo["t"] = now
+            return lag_memo["v"]
+
+        self._func("gossip_peer_behind_max", lambda: _lag()[0])
+        self._func("gossip_self_behind_max", lambda: _lag()[1])
+        self._func(
+            "selfevent_coalesced_total",
+            lambda: node.core.selfevent_coalesced,
         )
         self._func(
             "watchdog_trips_total",

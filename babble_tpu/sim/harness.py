@@ -58,7 +58,14 @@ def sim_addr(i: int) -> str:
 
 
 class _HonestDriver:
-    """One node's gossip heartbeat as a self-rescheduling event."""
+    """One node's gossip heartbeat as a self-rescheduling event.
+
+    With the adaptive scheduler on (``node.adaptive``), each tick asks
+    the node for its plan — the SAME control law the threaded
+    ControlTimer path runs, fed by the same virtual-time signals, so
+    adaptation is simulated honestly and deterministically (the law is
+    pure arithmetic; the only randomness is this driver's seeded
+    jitter stream). With it off, the seed's fixed cadence."""
 
     def __init__(self, node: Node, sch: SimScheduler, idx: int,
                  heartbeat_s: float):
@@ -79,15 +86,24 @@ class _HonestDriver:
 
     def _tick(self) -> None:
         node = self.node
+        interval = self.heartbeat_s
         if not self.down and node.get_state() == State.BABBLING:
-            peer = node.core.peer_selector.next()
-            if peer is not None:
-                node._gossip(peer)
+            fanout = 1
+            if node.adaptive is not None:
+                plan_interval, fanout = node.gossip_plan()
+                # the plan's rails are the node Config's heartbeat
+                # pair, which SimCluster derives from heartbeat_s — so
+                # the adaptive interval replaces the fixed cadence
+                interval = plan_interval
+            peers = node.core.peer_selector.next_many(fanout)
+            if peers:
+                for peer in peers:
+                    node._gossip(peer)
             else:
                 node._monologue()
-        # jittered cadence in [hb, 2hb) — same law as the control timer
+        # jittered cadence in [iv, 2*iv) — same law as the control timer
         self.sch.after(
-            self.heartbeat_s * (1.0 + self.rng.random()),
+            interval * (1.0 + self.rng.random()),
             self._tick,
             f"tick|n{self.idx}",
         )
@@ -152,6 +168,7 @@ class SimCluster:
         mempool_max_txs: int = 512,
         split: bool = False,
         trace_sample: Optional[float] = None,
+        adaptive: bool = True,
     ):
         self.sch = sch
         self.network = SimNetwork()
@@ -182,7 +199,7 @@ class SimCluster:
                 # trace every tx; stamps ride the SimClock, so same-seed
                 # runs export byte-identical provenance)
                 kw["trace_sample"] = trace_sample
-            return Config(
+            c = Config(
                 heartbeat_timeout=heartbeat_s,
                 slow_heartbeat_timeout=4 * heartbeat_s,
                 moniker=f"node{i}",
@@ -194,6 +211,12 @@ class SimCluster:
                 sim_seed=sch.seed,
                 **kw,
             )
+            # Pinned AFTER construction: the BABBLE_ADAPT env override
+            # (an operator switch for live clusters) must not silently
+            # flip a sim A/B arm — adaptive=False IS the control arm of
+            # the adaptive-vs-fixed recovery tests.
+            c.adaptive_gossip = adaptive
+            return c
 
         self.nodes: List[Node] = []
         self.proxies = []

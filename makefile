@@ -118,15 +118,24 @@ healthsmoke:
 tracesmoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m "not slow"
 
-# gossipsmoke: async gossip engine end to end — an 8-node MULTI-PROCESS
-# cluster on the event-driven transport + binary framed codec
-# (docs/gossip.md); asserts liveness (committed tx/s > 0), no-fork
+# gossipsmoke: async gossip engine end to end — the adaptive-vs-fixed
+# A/B on an 8-node MULTI-PROCESS cluster (event-driven transport +
+# binary framed codec, docs/gossip.md); the arms differ only by
+# BABBLE_ADAPT. Asserts liveness (committed tx/s > 0), no-fork
 # (byte-identical block Body at a cluster-wide committed index, checked
-# over HTTP), and a populated commit-latency histogram scraped from the
-# children's live /metrics. The bench asserts internally too; this
-# re-checks the parseable summary line (the driver tail contract).
+# over HTTP), a populated commit-latency histogram scraped from the
+# children's live /metrics, and the ISSUE-11 inequality: the adaptive
+# arm's committed tx/s >= the fixed arm's. The bench asserts internally
+# too; this re-checks the parseable summary line (driver tail contract).
 gossipsmoke:
-	JAX_PLATFORMS=cpu python bench.py --gossip --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['txs_per_s'] > 0, d; assert d['no_fork'] is True, d; assert d['clat_samples'] > 0, d; print('gossipsmoke ok:', d['txs_per_s'], 'tx/s, clat p50', d.get('clat_p50_ms'), 'ms, inflight peak', d.get('gossip_inflight_peak_max'))"
+	JAX_PLATFORMS=cpu python bench.py --gossip --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['txs_per_s'] > 0, d; assert d['no_fork'] is True, d; assert d['clat_samples'] > 0, d; assert d['ab_ok'] is True, d; print('gossipsmoke ok:', d['txs_per_s'], 'tx/s adaptive vs', d.get('fixed_txs_per_s'), 'fixed (ratio', str(d.get('adaptive_vs_fixed_ratio')) + '), clat p50', d.get('clat_p50_ms'), 'ms')"
+
+# adaptsmoke: the adaptive-scheduler A/B by itself — 4-node in-process
+# cluster per arm under identical load, arms differing only by
+# BABBLE_ADAPT; ledger-recorded so perfgate bands the adaptive/fixed
+# throughput + p50 ratios (docs/gossip.md §Adaptive scheduling)
+adaptsmoke:
+	JAX_PLATFORMS=cpu python bench.py --adaptive --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['adaptive_txs_per_s'] > 0, d; assert d['fixed_txs_per_s'] > 0, d; print('adaptsmoke ok: adaptive', d['adaptive_txs_per_s'], 'vs fixed', d['fixed_txs_per_s'], 'tx/s (ratio', str(d.get('adaptive_vs_fixed_ratio')) + '), p50 improvement', d.get('p50_improvement_ratio'))"
 
 # simsmoke: deterministic virtual-time scenario sweep — 200 seeded
 # chaos x byzantine x churn x overload combinations with invariant
@@ -150,4 +159,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke simsmoke simsweep wheel
