@@ -64,6 +64,12 @@ _RUN_FLAGS = {
     "sentry_threshold": ("sentry_threshold", float),
     "sentry_quarantine": ("sentry_quarantine_s", float),
     "sentry_decay_halflife": ("sentry_decay_halflife_s", float),
+    "client_listen": ("client_listen", str),
+    "sub_queue": ("sub_queue_frames", int),
+    "sub_stall_timeout": ("sub_stall_timeout_s", float),
+    "sub_shed_lag": ("sub_shed_lag", int),
+    "sub_sndbuf": ("sub_sndbuf", int),
+    "txindex_cap": ("txindex_cap", int),
     "trace_sample": ("trace_sample", float),
     "trace_table_cap": ("trace_table_cap", int),
     "watchdog_stall": ("watchdog_stall_s", float),
@@ -362,6 +368,35 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--sentry-decay-halflife", dest="sentry_decay_halflife", type=float,
         default=None, help="misbehavior score decay half-life in seconds",
+    )
+    run.add_argument(
+        "--client-listen", dest="client_listen", default=None,
+        help="bind the light-client SubscriptionHub here (streaming "
+        "commit subscriptions, docs/clients.md); empty = off",
+    )
+    run.add_argument(
+        "--sub-queue", dest="sub_queue", type=int, default=None,
+        help="bounded per-subscriber frame queue (docs/clients.md)",
+    )
+    run.add_argument(
+        "--sub-stall-timeout", dest="sub_stall_timeout", type=float,
+        default=None,
+        help="seconds a subscriber may stall with queued frames before "
+        "being shed",
+    )
+    run.add_argument(
+        "--sub-shed-lag", dest="sub_shed_lag", type=int, default=None,
+        help="delivery deficit in blocks beyond which a chronically "
+        "slow subscriber is shed",
+    )
+    run.add_argument(
+        "--sub-sndbuf", dest="sub_sndbuf", type=int, default=None,
+        help="kernel send-buffer cap per subscriber socket (0 = OS "
+        "default); small values make slow-consumer shedding prompt",
+    )
+    run.add_argument(
+        "--txindex-cap", dest="txindex_cap", type=int, default=None,
+        help="max transactions indexed for GET /proof/<txid>",
     )
     run.add_argument(
         "--trace-sample", dest="trace_sample", type=float, default=None,

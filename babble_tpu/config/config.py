@@ -173,6 +173,25 @@ class Config:
     # nodes; 0 disables, env BABBLE_PROFILE_HZ overrides cluster-wide.
     profile_hz: float = DEFAULT_PROFILE_HZ
 
+    # Light-client gateway tier (docs/clients.md): client_listen binds
+    # the SubscriptionHub (streaming commit subscriptions over one
+    # selector loop; empty = off). Per-subscriber frame queues are
+    # bounded (sub_queue_frames); a subscriber that stalls with queued
+    # data for sub_stall_timeout_s, or whose delivery deficit grows past
+    # sub_shed_lag blocks, is shed. txindex_cap bounds the txid→block
+    # proof index behind GET /proof/<txid>.
+    client_listen: str = ""
+    sub_queue_frames: int = 256
+    sub_stall_timeout_s: float = 10.0
+    sub_shed_lag: int = 1024
+    # kernel send-buffer cap per subscriber socket (0 = OS default);
+    # small values make slow-consumer shedding prompt and deterministic
+    sub_sndbuf: int = 0
+    # proof-index bound: ~64-byte hex key + coords per entry; 256k
+    # entries ≈ tens of MB. Indexing runs only when the node has a read
+    # surface (service or client_listen).
+    txindex_cap: int = 1 << 18
+
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory
     database_dir: str = ""

@@ -1,0 +1,123 @@
+"""Binary Merkle tree over a block's transaction list.
+
+The light-client gateway (docs/clients.md) serves *inclusion proofs*:
+a stateless client holding only the validator set can check that one
+transaction is inside a committed block without downloading the block.
+That requires validators to sign something that commits to the
+transactions through a Merkle root instead of the raw list — see
+``BlockBody.tx_root`` (hashgraph/block.py) and the parity note in
+docs/parity.md.
+
+Construction is RFC 6962-style (Certificate Transparency):
+
+- leaf  = sha256(0x00 || tx)
+- inner = sha256(0x01 || left || right)
+- an odd node at the end of a level is *promoted* unchanged (never
+  duplicated — duplication lets two different leaf lists share a root,
+  the classic CVE-2012-2459 mutation), and the leaf count is part of
+  the signed header anyway (``TxCount``) so tree shape is pinned.
+- the empty tree hashes to sha256(b"") — a constant that can never
+  collide with a leaf or inner node, both of which hash prefixed input.
+
+An audit path is the sibling hash at each level from the leaf to the
+root, each tagged with which side the sibling sits on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence, Tuple
+
+_LEAF_PREFIX = b"\x00"
+_NODE_PREFIX = b"\x01"
+
+#: root of the empty tree (no transactions in the block)
+EMPTY_ROOT = hashlib.sha256(b"").digest()
+
+
+def leaf_hash(tx: bytes) -> bytes:
+    return hashlib.sha256(_LEAF_PREFIX + tx).digest()
+
+
+def node_hash(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(_NODE_PREFIX + left + right).digest()
+
+
+def merkle_root(txs: Sequence[bytes]) -> bytes:
+    """Root over the transaction list (order-sensitive)."""
+    if not txs:
+        return EMPTY_ROOT
+    level = [leaf_hash(t) for t in txs]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            nxt.append(node_hash(level[i], level[i + 1]))
+        if len(level) % 2:  # odd tail promotes unchanged
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def merkle_path(txs: Sequence[bytes], index: int) -> List[Tuple[bytes, bool]]:
+    """Audit path for ``txs[index]``: [(sibling_hash, sibling_is_right),
+    ...] from leaf level to just below the root."""
+    if not 0 <= index < len(txs):
+        raise IndexError(f"leaf index {index} out of range 0..{len(txs) - 1}")
+    level = [leaf_hash(t) for t in txs]
+    pos = index
+    path: List[Tuple[bytes, bool]] = []
+    while len(level) > 1:
+        sib = pos ^ 1
+        if sib < len(level):
+            path.append((level[sib], sib > pos))
+        # else: odd tail promoted — no sibling at this level
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            nxt.append(node_hash(level[i], level[i + 1]))
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+        pos //= 2
+    return path
+
+
+def verify_path(
+    tx: bytes, index: int, count: int, path: Sequence[Tuple[bytes, bool]],
+    root: bytes,
+) -> bool:
+    """Recompute the root from one transaction and its audit path.
+
+    ``count`` is the signed leaf count (``TxCount``): it bounds the path
+    length and pins the position walk, so a path valid for one (index,
+    count) cannot be replayed for another tree shape."""
+    if count <= 0 or not 0 <= index < count:
+        return False
+    # expected path length: one sibling per level where we have one
+    expect = 0
+    pos, n = index, count
+    while n > 1:
+        if (pos ^ 1) < n:
+            expect += 1
+        pos //= 2
+        n = (n + 1) // 2
+    if len(path) != expect:
+        return False
+    h = leaf_hash(tx)
+    pos, n = index, count
+    i = 0
+    while n > 1:
+        if (pos ^ 1) < n:
+            sib, right = path[i]
+            i += 1
+            if not isinstance(sib, (bytes, bytearray)) or len(sib) != 32:
+                return False
+            # the sibling's side is DERIVED from the position walk, never
+            # trusted from the path — a flag that contradicts the claimed
+            # index is a forgery attempt (a left/right swap can re-root a
+            # path onto a different leaf position)
+            if bool(right) != (pos % 2 == 0):
+                return False
+            h = node_hash(h, bytes(sib)) if right else node_hash(bytes(sib), h)
+        pos //= 2
+        n = (n + 1) // 2
+    return h == root

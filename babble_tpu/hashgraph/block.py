@@ -9,6 +9,7 @@ from typing import Dict, List, Optional
 from babble_tpu.crypto.canonical import canonical_dumps
 from babble_tpu.crypto.hashing import sha256
 from babble_tpu.crypto.keys import PrivateKey, PublicKey
+from babble_tpu.crypto.merkle import merkle_root
 from babble_tpu.hashgraph.event import BlockSignature, decode_hash, encode_hash
 from babble_tpu.hashgraph.internal_transaction import (
     InternalTransaction,
@@ -41,7 +42,33 @@ class BlockBody:
             "StateHash": self.state_hash,
             "FrameHash": self.frame_hash,
             "PeersHash": self.peers_hash,
+            "TxRoot": self.tx_root(),
             "Transactions": list(self.transactions),
+            "InternalTransactions": [
+                t.to_dict() for t in self.internal_transactions
+            ],
+            "InternalTransactionReceipts": [
+                r.to_dict() for r in self.internal_transaction_receipts
+            ],
+        }
+
+    def header_dict(self) -> dict:
+        """The SIGNED form of the body (docs/clients.md §Proof format,
+        docs/parity.md): every field of to_dict except the raw
+        transaction list, which is committed through its Merkle root +
+        leaf count. Validators sign the hash of THIS dict, so an
+        inclusion proof only has to carry the header, never the block's
+        other transactions. The reference signs the full body
+        (block.go:49-55) — deliberate divergence."""
+        return {
+            "Index": self.index,
+            "RoundReceived": self.round_received,
+            "Timestamp": self.timestamp,
+            "StateHash": self.state_hash,
+            "FrameHash": self.frame_hash,
+            "PeersHash": self.peers_hash,
+            "TxRoot": self.tx_root(),
+            "TxCount": len(self.transactions),
             "InternalTransactions": [
                 t.to_dict() for t in self.internal_transactions
             ],
@@ -58,23 +85,36 @@ class BlockBody:
         # lost-invalidation race a reader thread hits while commit fills
         # the body).
         object.__setattr__(self, name, value)
-        if name not in ("_hash_cache", "_hash_version"):
+        if name not in ("_hash_cache", "_hash_version", "_tx_root_cache"):
             object.__setattr__(
                 self, "_hash_version", getattr(self, "_hash_version", 0) + 1
             )
 
+    def tx_root(self) -> bytes:
+        """Merkle root over the transaction list (crypto/merkle.py),
+        cached with the same versioning discipline as hash()."""
+        ver = getattr(self, "_hash_version", 0)
+        cached = getattr(self, "_tx_root_cache", None)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        root = merkle_root(self.transactions)
+        object.__setattr__(self, "_tx_root_cache", (ver, root))
+        return root
+
     def hash(self) -> bytes:
-        """SHA256 of the canonical encoding — what validators sign
-        (reference: block.go:49-55). Cached until a field changes: the sig
-        pool re-verifies against this hash once per gossiped signature.
-        The cache entry is (version, digest); a digest computed against a
-        body that mutated mid-walk carries a stale version and is simply
-        recomputed on the next call."""
+        """SHA256 of the canonical HEADER encoding — what validators sign
+        (header_dict: transactions committed via TxRoot+TxCount; the
+        reference hashes the full body, block.go:49-55 — divergence
+        recorded in docs/parity.md). Cached until a field changes: the
+        sig pool re-verifies against this hash once per gossiped
+        signature. The cache entry is (version, digest); a digest
+        computed against a body that mutated mid-walk carries a stale
+        version and is simply recomputed on the next call."""
         ver = getattr(self, "_hash_version", 0)
         cached = getattr(self, "_hash_cache", None)
         if cached is not None and cached[0] == ver:
             return cached[1]
-        digest = sha256(canonical_dumps(self.to_dict()))
+        digest = sha256(canonical_dumps(self.header_dict()))
         object.__setattr__(self, "_hash_cache", (ver, digest))
         return digest
 

@@ -148,6 +148,14 @@ class Core:
         self.selfevent_burst = max(0, int(selfevent_burst))
         self.selfevent_coalesced = 0
 
+        # Commit listeners (docs/clients.md): called AFTER a block is
+        # fully committed (state hash + receipts filled, own signature
+        # attached) — the hook feeding the tx→block proof index and the
+        # subscription hub. Listeners must be cheap/non-blocking; a
+        # listener crash is contained so consensus can never stall on
+        # the read tier.
+        self.commit_listeners: List[Callable[[Block], None]] = []
+
         self.hg = Hashgraph(store, self.commit)
         self.hg.init(genesis_peers)
         # Fork evidence is scored against the *creator*, not the relaying
@@ -712,6 +720,12 @@ class Core:
         self.process_accepted_internal_transactions(
             block.round_received(), commit_response.receipts
         )
+
+        for listener in self.commit_listeners:
+            try:
+                listener(block)
+            except Exception:  # noqa: BLE001 — the read tier never stalls consensus
+                logger.debug("commit listener failed", exc_info=True)
 
     def sign_block(self, block: Block):
         """reference: core.go:539-556."""

@@ -231,6 +231,40 @@ class Node(StateManager):
             self.pipeline = SyncPipeline(
                 self, queue_cap=conf.gossip_pipeline_depth
             )
+        # Light-client gateway tier (docs/clients.md): the tx→block
+        # proof index always runs (GET /proof/<txid> works on any node
+        # with a service); the SubscriptionHub binds only when
+        # --client-listen is set AND the node runs on the wall clock
+        # (the sim engine drives commits deterministically and must not
+        # grow a socket thread).
+        from ..client.proofs import TxIndex
+
+        self.txindex = TxIndex(conf.txindex_cap)
+        # Index commits only when something can serve reads — a
+        # subscription hub or the HTTP service (GET /proof). A pure
+        # validator (--no-service, no --client-listen) skips the
+        # per-commit sha256 walk and the index memory entirely; sim
+        # clusters (no_service=True) keep their commit path lean too.
+        self._txindex_enabled = bool(conf.client_listen) or not conf.no_service
+        self.proofs_served = 0
+        self.proof_misses = 0
+        self.checkpoint_exports = 0
+        self.client_hub = None
+        if conf.client_listen and self.clock is WALL:
+            from ..client.subhub import SubscriptionHub
+
+            self.client_hub = SubscriptionHub(
+                conf.client_listen,
+                block_source=self.get_sealed_block,
+                moniker=conf.moniker,
+                queue_frames=conf.sub_queue_frames,
+                stall_timeout_s=conf.sub_stall_timeout_s,
+                shed_lag=conf.sub_shed_lag,
+                sndbuf=conf.sub_sndbuf,
+                clock=self.clock,
+            )
+            self.client_hub.listen()
+        self.core.commit_listeners.append(self._on_commit_block)
         self.telemetry.bind_node(self)
 
     # -- lifecycle ----------------------------------------------------------
@@ -387,6 +421,8 @@ class Node(StateManager):
             self.watchdog.stop()
             if self.pipeline is not None:
                 self.pipeline.stop()
+            if self.client_hub is not None:
+                self.client_hub.close()
             self.control_timer.shutdown()
             self.wait_routines(timeout=2.0)
             if self.trans is not None:
@@ -431,6 +467,66 @@ class Node(StateManager):
 
     def get_all_validator_sets(self) -> Dict[int, List[Peer]]:
         return self.core.hg.store.get_all_peer_sets()
+
+    # -- light-client gateway tier (docs/clients.md) -------------------------
+
+    def _on_commit_block(self, block) -> None:
+        """Core commit listener: index the block's transactions for
+        proofs (when a read surface exists) and advance the subscription
+        hub's head watermark (O(1); the hub encodes and pushes from its
+        own thread)."""
+        if self._txindex_enabled:
+            self.txindex.index_block(block)
+        if self.client_hub is not None:
+            self.client_hub.publish(block.index())
+
+    def get_sealed_block(self, index: int):
+        """Block ``index`` once SEALED — carrying MORE than 1/3
+        validator signatures, the bar the anchor logic and every
+        stateless verifier use — else None. The hub re-polls Nones, so
+        subscribers see each block as soon as enough signatures have
+        gossiped in, in order, with no gaps."""
+        if index < 0 or index > self.core.get_last_block_index():
+            return None
+        try:
+            block = self.core.hg.store.get_block(index)
+            peer_set = self.core.hg.store.get_peer_set(
+                block.round_received()
+            )
+        except Exception:  # noqa: BLE001 — evicted/missing: not servable
+            return None
+        if len(block.signatures) <= peer_set.trust_count():
+            return None
+        return block
+
+    def get_proof(self, txid: str) -> Optional[Dict[str, object]]:
+        """Signed Merkle inclusion proof for one committed transaction
+        (GET /proof/<txid>; None = unknown/aged out → 404). Signatures
+        accumulate for a round or two after commit — a proof fetched
+        too early simply carries fewer of them and the client retries."""
+        from ..client.proofs import build_proof
+
+        loc = self.txindex.lookup(txid)
+        if loc is None:
+            self.proof_misses += 1
+            return None
+        try:
+            block = self.core.hg.store.get_block(loc[0])
+        except Exception:  # noqa: BLE001 — block aged out of the store
+            self.proof_misses += 1
+            return None
+        self.proofs_served += 1
+        return build_proof(block, loc[1])
+
+    def get_checkpoint(self) -> Dict[str, object]:
+        """Signed fast-sync checkpoint (GET /checkpoint): the anchor
+        block + its frame. Raises while no block is sealed yet."""
+        from ..client.checkpoint import export_checkpoint
+
+        with self.core_lock:
+            cp = export_checkpoint(self.core)
+        self.checkpoint_exports += 1
+        return cp
 
     def get_stats_snapshot(self) -> Dict[str, object]:
         """One TYPED stats snapshot (numbers stay numbers) — the single
@@ -504,6 +600,18 @@ class Node(StateManager):
         stats["trace_provenance_evictions"] = prov["evictions"]
         stats["watchdog_trips"] = self.watchdog.trips
         stats["flight_dumps"] = self.watchdog.dumps
+        # Light-client gateway surface (docs/clients.md): subscription
+        # hub occupancy (zeros while --client-listen is off) + the
+        # proof-serving counters.
+        hub = self.client_hub.stats() if self.client_hub is not None else {}
+        stats["client_subscribers"] = hub.get("subscribers", 0)
+        stats["client_sub_queue_frames_max"] = hub.get("queue_frames_max", 0)
+        stats["client_pushed_blocks"] = hub.get("pushed_blocks", 0)
+        stats["client_shed_subscribers"] = hub.get("shed", 0)
+        stats["client_proofs_served"] = self.proofs_served
+        stats["client_proof_misses"] = self.proof_misses
+        stats["client_txindex_entries"] = len(self.txindex)
+        stats["client_checkpoint_exports"] = self.checkpoint_exports
         stats.update(self.core.peer_selector.stats())
         stats["sync_limit_truncations"] = self.sync_limit_truncations
         stats["sync_diff_truncations"] = self.sync_diff_truncations

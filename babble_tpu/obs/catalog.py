@@ -253,6 +253,45 @@ CATALOG: Tuple[Instrument, ...] = (
         "mempool_inflight_aged_total", _C, (), "node",
         "In-flight hashes aged out past the dedup cap.",
     ),
+    # -- light-client gateway tier (docs/clients.md) ------------------------
+    Instrument(
+        "client_subscribers", _G, (), "node",
+        "Live streaming-subscription connections on this node's "
+        "SubscriptionHub (0 when --client-listen is off).",
+    ),
+    Instrument(
+        "client_sub_queue_frames_max", _G, (), "node",
+        "Largest per-subscriber outbound frame queue right now "
+        "(sampled at scrape; the bound is sub_queue_frames).",
+    ),
+    Instrument(
+        "client_pushed_blocks_total", _C, (), "node",
+        "Sealed block frames queued to subscribers (one per block per "
+        "subscriber).",
+    ),
+    Instrument(
+        "client_shed_subscribers_total", _C, (), "node",
+        "Subscribers shed for stalling (no socket progress with queued "
+        "frames) or a chronic delivery deficit.",
+    ),
+    Instrument(
+        "client_proofs_served_total", _C, (), "node",
+        "GET /proof/<txid> requests answered with a signed Merkle "
+        "inclusion proof.",
+    ),
+    Instrument(
+        "client_proof_misses_total", _C, (), "node",
+        "Proof lookups for unknown or aged-out transactions (404s).",
+    ),
+    Instrument(
+        "client_txindex_entries", _G, (), "node",
+        "Transactions currently indexed for proof serving (bounded by "
+        "txindex_cap, oldest aged out).",
+    ),
+    Instrument(
+        "client_checkpoint_exports_total", _C, (), "node",
+        "GET /checkpoint fast-sync snapshots exported.",
+    ),
     # -- causal tracing / flight recorder ----------------------------------
     Instrument(
         "trace_sampled_txs_total", _C, (), "node",

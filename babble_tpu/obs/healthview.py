@@ -196,6 +196,9 @@ def sample_from_stats(entry: dict) -> dict:
         "mempool_pending": num("mempool_pending_count",
                                num("transaction_pool")),
         "sentry_quarantined_peers": num("sentry_quarantined_peers"),
+        "client_subscribers": num("client_subscribers"),
+        "client_shed_subscribers_total": num("client_shed_subscribers"),
+        "client_proofs_served_total": num("client_proofs_served"),
     }
     clat_p50_ms = stats.get("commit_latency_p50_ms")
     return {
@@ -284,6 +287,15 @@ def merge(samples0: List[Optional[dict]], samples1: List[Optional[dict]],
                 ),
                 "mempool_pending": _metric(s1, "mempool_pending"),
             },
+            # light-client read tier (docs/clients.md): live
+            # subscription fan-out + slow-consumer shedding per node
+            "subscribers": int(_metric(s1, "client_subscribers")),
+            "shed_subscribers": int(
+                _metric(s1, "client_shed_subscribers_total")
+            ),
+            "proofs_served": int(
+                _metric(s1, "client_proofs_served_total")
+            ),
             "quarantined_peers": int(
                 _metric(s1, "sentry_quarantined_peers")
             ),
@@ -424,7 +436,7 @@ def render(view: dict) -> str:
         ),
         f"{'node':<10} {'state':<10} {'round':>7} {'lag':>4} "
         f"{'rnd/s':>7} {'blk/s':>7} {'p50ms':>8} {'burn':>6} "
-        f"{'queues s/p/q/m':>16} {'quar':>4}  health",
+        f"{'queues s/p/q/m':>16} {'subs':>5} {'shed':>4} {'quar':>4}  health",
     ]
     for n in view["nodes"]:
         if n.get("down"):
@@ -444,7 +456,9 @@ def render(view: dict) -> str:
             f"{('-' if n['slo_burn_rate'] is None else n['slo_burn_rate']):>6} "
             f"{q['submit']:.0f}/{q['pipeline_inflight']:.0f}"
             f"/{q['pipeline_queue']:.0f}/{q['mempool_pending']:>.0f}"
-            f"{'':>4}{n['quarantined_peers']:>4}  "
+            f"{'':>4}{n.get('subscribers', 0):>5} "
+            f"{n.get('shed_subscribers', 0):>4} "
+            f"{n['quarantined_peers']:>4}  "
             + ("ok" if n.get("healthy") else "UNHEALTHY")
         )
     return "\n".join(lines)

@@ -447,6 +447,43 @@ class NodeTelemetry:
             "selfevent_coalesced_total",
             lambda: node.core.selfevent_coalesced,
         )
+        # Light-client gateway tier (docs/clients.md): hub gauges read 0
+        # while --client-listen is off; the proof index always runs.
+        # One stats() sweep serves all four hub instruments per collect
+        # pass (the selector/lag memo shape).
+        hub_memo = {"t": -1.0, "v": None}
+
+        def _hub_stats():
+            now = time.monotonic()
+            if hub_memo["v"] is None or now - hub_memo["t"] > 0.05:
+                hub = node.client_hub
+                hub_memo["v"] = hub.stats() if hub is not None else {}
+                hub_memo["t"] = now
+            return hub_memo["v"]
+
+        self._func(
+            "client_subscribers",
+            lambda: _hub_stats().get("subscribers", 0),
+        )
+        self._func(
+            "client_sub_queue_frames_max",
+            lambda: _hub_stats().get("queue_frames_max", 0),
+        )
+        self._func(
+            "client_pushed_blocks_total",
+            lambda: _hub_stats().get("pushed_blocks", 0),
+        )
+        self._func(
+            "client_shed_subscribers_total",
+            lambda: _hub_stats().get("shed", 0),
+        )
+        self._func("client_proofs_served_total", lambda: node.proofs_served)
+        self._func("client_proof_misses_total", lambda: node.proof_misses)
+        self._func("client_txindex_entries", lambda: len(node.txindex))
+        self._func(
+            "client_checkpoint_exports_total",
+            lambda: node.checkpoint_exports,
+        )
         self._func(
             "watchdog_trips_total",
             lambda: getattr(node.watchdog, "trips", 0),

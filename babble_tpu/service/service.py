@@ -7,7 +7,9 @@ telemetry surface (docs/observability.md): /metrics (Prometheus text
 exposition), /telemetry (structured JSON with computed percentiles and
 recent sync traces), /mempool, /suspects, /profile (the sampling
 profiler's stage-attributed collapsed stacks; /debug/profile aliases
-it), and the /debug/* routes (timers, thread stacks). Built on the stdlib
+it), the /debug/* routes (timers, thread stacks), and the light-client
+read surface (docs/clients.md): /proof/{txid} (signed Merkle inclusion
+proof) and /checkpoint (fast-sync snapshot for read replicas). Built on the stdlib
 ThreadingHTTPServer (the reference rides http.DefaultServeMux so an
 in-process app can share the port; here an app can mount extra handlers
 via ``extra_routes``)."""
@@ -104,6 +106,22 @@ class Service:
                 body = self.node.get_traces(
                     limit=int(qs.get("limit", ["256"])[0])
                 )
+            elif path.startswith("/proof/"):
+                # signed Merkle inclusion proof for one committed tx
+                # (docs/clients.md §Proofs); verified offline by
+                # client.verifier from the validator set alone
+                body = self.node.get_proof(path[len("/proof/"):])
+                if body is None:
+                    self._send(req, 404, {"error": "unknown txid"})
+                    return
+            elif path == "/checkpoint":
+                # signed fast-sync snapshot for read-replica spin-up
+                # (docs/clients.md §Checkpoints)
+                try:
+                    body = self.node.get_checkpoint()
+                except ValueError as err:
+                    self._send(req, 404, {"error": str(err)})
+                    return
             elif path.startswith("/block/"):
                 body = _jsonable(
                     self.node.get_block(int(path[len("/block/"):])).to_dict()

@@ -1505,6 +1505,193 @@ def bench_obs(n_nodes: int = 3, target_txs: int = 150,
     return out
 
 
+def bench_clients(n_nodes: int = 4, subscribers: int = 2000,
+                  window_s: float = 10.0, proof_samples: int = 16,
+                  smoke: bool = False):
+    """Light-client gateway bench (docs/clients.md §Benching): a 4-node
+    TCP cluster, every node serving a SubscriptionHub, with
+    ``subscribers`` streaming clients attached through one selector-loop
+    swarm. Measures subscriber fan-out (block frames delivered to
+    healthy subscribers per second), push latency (hub send stamp →
+    client receive), and proof-serving latency (GET /proof/<txid> over
+    HTTP until the proof verifies OFFLINE against the validator set).
+    Ordering is asserted: zero gaps across every healthy subscriber."""
+    import urllib.request
+
+    from babble_tpu.client.proofs import txid_hex
+    from babble_tpu.client.swarm import SubscriberSwarm
+    from babble_tpu.client.verifier import ProofError, verify_proof
+    from babble_tpu.config.config import Config
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.dummy.state import State as DummyState
+    from babble_tpu.hashgraph.store import InmemStore
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.node.node import Node
+    from babble_tpu.node.validator import Validator
+    from babble_tpu.peers.peer import Peer
+    from babble_tpu.peers.peer_set import PeerSet
+    from babble_tpu.proxy.proxy import InmemProxy
+    from babble_tpu.service.service import Service
+
+    if smoke:
+        subscribers = 200
+        window_s = 6.0
+        proof_samples = 8
+
+    transports = [
+        TCPTransport("127.0.0.1:0", max_pool=2, timeout=5.0)
+        for _ in range(n_nodes)
+    ]
+    for t in transports:
+        t.listen()
+    keys = [generate_key() for _ in range(n_nodes)]
+    peers = PeerSet(
+        [Peer(t.advertise_addr(), k.public_key.hex(), f"cl{i}")
+         for i, (t, k) in enumerate(zip(transports, keys))]
+    )
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.01, slow_heartbeat_timeout=0.2,
+            log_level="error", moniker=f"cl{i}",
+            client_listen="127.0.0.1:0",
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        node = Node(conf, Validator(k, f"cl{i}"), peers, peers,
+                    InmemStore(conf.cache_size), transports[i], pr)
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    service = Service("127.0.0.1:0", nodes[0], logger=None)
+    service.serve_async()
+    swarm = SubscriberSwarm(
+        [n.client_hub.bind_addr for n in nodes], subscribers, start=-1
+    )
+    accepted: list = []
+    try:
+        for n in nodes:
+            n.run_async()
+        swarm.start_all()
+
+        t_end = time.monotonic() + window_s
+        i = 0
+        backlog = 64
+        while time.monotonic() < t_end:
+            if (len(accepted)
+                    - min(len(s.committed_txs) for s in states)) < backlog:
+                tx = f"client bench tx {i}".encode()
+                i += 1
+                if proxies[i % n_nodes].submit_tx(tx) == "accepted":
+                    accepted.append(tx)
+            else:
+                time.sleep(0.002)
+        # rate snapshot at WINDOW END — the settle below exists so the
+        # tail of the stream reaches the swarm for the ordering checks,
+        # and counting its deliveries against window_s would inflate
+        # the ledger-recorded rate perfgate bands against
+        window_stats = swarm.stats()
+        # settle: let the last blocks seal + push
+        settle_end = time.monotonic() + (5.0 if smoke else 10.0)
+        while time.monotonic() < settle_end:
+            time.sleep(0.2)
+        sub_stats = swarm.stats()
+
+        # proof serving: sampled accepted txs over live HTTP until each
+        # verifies offline (signatures may still be accumulating)
+        proof_ms: list = []
+        verified = 0
+        sample = accepted[:: max(1, len(accepted) // proof_samples)][
+            :proof_samples
+        ]
+        for tx in sample:
+            tid = txid_hex(tx)
+            deadline = time.monotonic() + 20.0
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{service.bind_addr}/proof/{tid}",
+                        timeout=5.0,
+                    ) as r:
+                        proof = json.loads(r.read())
+                    dt = time.perf_counter() - t0
+                    verify_proof(proof, peers)
+                    proof_ms.append(1e3 * dt)
+                    verified += 1
+                    break
+                except (ProofError, OSError, ValueError):
+                    if time.monotonic() > deadline:
+                        break
+                    time.sleep(0.2)
+        proof_ms.sort()
+        committed = min(len(s.committed_txs) for s in states)
+        blocks_delivered = sub_stats["blocks_received"]
+        return {
+            "n_nodes": n_nodes,
+            "subscribers": len(swarm.members),
+            "sub_connect_errors": sub_stats["connect_errors"],
+            "sub_blocks_received": blocks_delivered,
+            "sub_min_blocks": sub_stats["min_blocks"],
+            "sub_gaps": sub_stats["gaps"],
+            "sub_shed": sub_stats["shed_notices"],
+            "fanout_blocks_per_s": round(
+                window_stats["blocks_received"] / window_s, 1
+            ),
+            "push_latency_p50_ms": (
+                None if sub_stats["push_latency_p50_s"] is None
+                else round(1e3 * sub_stats["push_latency_p50_s"], 1)
+            ),
+            "push_latency_p99_ms": (
+                None if sub_stats["push_latency_p99_s"] is None
+                else round(1e3 * sub_stats["push_latency_p99_s"], 1)
+            ),
+            "committed_txs": committed,
+            "committed_txs_per_s": round(committed / window_s, 1),
+            "proof_sampled": len(sample),
+            "proof_verified": verified,
+            "proof_verify_ok": bool(sample) and verified == len(sample),
+            "proof_latency_p50_ms": (
+                round(_percentile(proof_ms, 0.50), 2) if proof_ms else None
+            ),
+            "proof_latency_p99_ms": (
+                round(_percentile(proof_ms, 0.99), 2) if proof_ms else None
+            ),
+        }
+    finally:
+        swarm.stop()
+        service.shutdown()
+        for n in nodes:
+            n.shutdown()
+
+
+def main_clients(smoke: bool = False) -> None:
+    """`make clientbench` / `bench.py --clients`: subscriber fan-out +
+    proof-serving latency, detail on stderr and ONE parseable JSON line
+    on stdout (the tail-capture contract)."""
+    res = bench_clients(smoke=smoke)
+    print(
+        f"clients: {res['subscribers']} subscribers, "
+        f"{res['sub_blocks_received']} block frames delivered "
+        f"({res['fanout_blocks_per_s']}/s, gaps={res['sub_gaps']}), "
+        f"push p50={res['push_latency_p50_ms']}ms "
+        f"p99={res['push_latency_p99_ms']}ms; proofs "
+        f"{res['proof_verified']}/{res['proof_sampled']} verified, "
+        f"p50={res['proof_latency_p50_ms']}ms",
+        file=sys.stderr,
+    )
+    assert res["sub_gaps"] == 0, res
+    assert res["proof_verify_ok"], res
+    _ledger_append("clients_smoke" if smoke else "clients", res)
+    line = json.dumps(
+        {"bench_summary": "clients_smoke" if smoke else "clients", **res},
+        separators=(",", ":"),
+    )
+    assert len(line) < 2000, "clients summary exceeded tail-capture budget"
+    print(line)
+
+
 def main_obs(smoke: bool = False) -> None:
     """`make obssmoke` / `bench.py --obs`: the observability smoke,
     detail on stderr and ONE parseable JSON line on stdout."""
@@ -2448,6 +2635,8 @@ def main() -> None:
         return main_nodes16proc()
     if "--dag" in sys.argv:
         return main_dag("--smoke" in sys.argv)
+    if "--clients" in sys.argv:
+        return main_clients("--smoke" in sys.argv)
     if "--mempool" in sys.argv:
         return main_mempool("--smoke" in sys.argv)
     if "--obs" in sys.argv:

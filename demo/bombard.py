@@ -10,6 +10,16 @@ shed. Totals (accepted / shed / duplicate / ...) print at exit.
 
 Usage:  python demo/bombard.py [n_nodes] [txs_per_node] [--base-port 13000]
                                [--metrics=host:port,host:port,...]
+                               [--subscribers=N] [--sub-addr=host:port,...]
+                               [--stall-frac=0.0]
+
+With ``--subscribers=N`` (docs/clients.md), N concurrent streaming
+subscribers (one selector thread, N sockets — 10k+ is fine) attach to
+the listed ``--sub-addr`` SubscriptionHubs (default
+127.0.0.1:15000..+n, the demo/testnet.py layout) for the whole
+bombardment; at exit the swarm reports blocks received, ordering gaps
+(must be 0 on healthy subscribers), push-latency p50/p99, and how many
+deliberately-stalled subscribers (``--stall-frac``) the hub shed.
 
 With ``--metrics``, each listed node's ``GET /metrics`` (the service's
 Prometheus endpoint, docs/observability.md) is scraped after the
@@ -121,6 +131,8 @@ def healthview_summary(endpoints: str, window_s: float = 4.0) -> None:
             f"pipeline={q['pipeline_inflight']:.0f}"
             f"/{q['pipeline_queue']:.0f} "
             f"mempool={q['mempool_pending']:.0f} "
+            f"subs={n.get('subscribers', 0)} "
+            f"shed={n.get('shed_subscribers', 0)} "
             f"quarantined={n['quarantined_peers']} "
             + ("ok" if n.get("healthy") else "UNHEALTHY")
         )
@@ -272,6 +284,32 @@ def main() -> int:
             float(opts.get("duration", "20")), opts.get("listen", ""),
         )
 
+    swarm = None
+    if "subscribers" in opts:
+        from babble_tpu.client.swarm import SubscriberSwarm
+
+        sub_addrs = [
+            a.strip()
+            for a in opts.get(
+                "sub-addr",
+                ",".join(f"127.0.0.1:{15000 + i}" for i in range(n)),
+            ).split(",")
+            if a.strip()
+        ]
+        swarm = SubscriberSwarm(
+            sub_addrs,
+            int(opts["subscribers"]),
+            start=-1,
+            stall_frac=float(opts.get("stall-frac", "0.0")),
+        )
+        swarm.start_all()
+        print(
+            f"subscribers: {len(swarm.members)} attached across "
+            f"{len(sub_addrs)} hub(s) "
+            f"({swarm.stall_count} deliberately stalled, "
+            f"{swarm.connect_errors} connect errors)"
+        )
+
     counts: dict = {"shed": 0, "backoffs": 0}
     sent = 0
     accepted_txs: list = []
@@ -296,6 +334,23 @@ def main() -> int:
     )
     if sent:
         print(f"shed rate: {counts['shed'] / sent:.3f}")
+    if swarm is not None:
+        # let the tail of the commits reach the stream before reporting
+        time.sleep(float(opts.get("sub-settle", "5")))
+        s = swarm.stats()
+        swarm.stop()
+        lat50 = s["push_latency_p50_s"]
+        lat99 = s["push_latency_p99_s"]
+        print(
+            f"subscribers: {s['subscribers']} "
+            f"({s['stalled']} stalled bait), blocks pushed to healthy: "
+            f"{s['blocks_received']} (min/sub {s['min_blocks']}), "
+            f"gaps {s['gaps']}, shed notices {s['shed_notices']}, "
+            "push latency p50 "
+            + (f"{1e3 * lat50:.0f}ms" if lat50 is not None else "-")
+            + " p99 "
+            + (f"{1e3 * lat99:.0f}ms" if lat99 is not None else "-")
+        )
     if "metrics" in opts:
         scrape_commit_latency(opts["metrics"])
         healthview_summary(opts["metrics"])

@@ -137,6 +137,33 @@ gossipsmoke:
 adaptsmoke:
 	JAX_PLATFORMS=cpu python bench.py --adaptive --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['adaptive_txs_per_s'] > 0, d; assert d['fixed_txs_per_s'] > 0, d; print('adaptsmoke ok: adaptive', d['adaptive_txs_per_s'], 'vs fixed', d['fixed_txs_per_s'], 'tx/s (ratio', str(d.get('adaptive_vs_fixed_ratio')) + '), p50 improvement', d.get('p50_improvement_ratio'))"
 
+# clientsmoke: light-client gateway tier end to end (docs/clients.md) —
+# a live 4-validator TCP cluster with one sharded gateway and a
+# 100-subscriber swarm: every sampled accepted tx's GET /proof/<txid>
+# verifies OFFLINE from the validator set alone, pushed blocks arrive
+# in order with zero gaps on healthy subscribers, and a deliberately
+# stalled subscriber is shed without raising anyone else's push
+# latency; plus the adversarial proof/checkpoint unit coverage.
+clientsmoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_client.py -q -m "not slow"
+
+# clientbench: subscriber fan-out throughput + proof-serving latency,
+# ledger-recorded so perfgate bands regressions (bench.py --clients)
+clientbench:
+	JAX_PLATFORMS=cpu python bench.py --clients --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['sub_blocks_received'] > 0, d; assert d['sub_gaps'] == 0, d; assert d['proof_verify_ok'], d; print('clientbench ok:', d['fanout_blocks_per_s'], 'pushed blocks/s to', d['subscribers'], 'subs, proof p50', d['proof_latency_p50_ms'], 'ms')"
+
+# killtestnet: reap stray demo/testnet.py processes from an aborted run
+# — they squat the demo ports and poison later perfgate baselines. The
+# well-known pidfile covers even a SIGKILLed driver; each recorded PID
+# is verified against /proc/<pid>/cmdline before any signal, so a PID
+# the OS recycled to an unrelated process is never touched. The pattern
+# sweep catches nodes whose pidfile was lost.
+killtestnet:
+	-@if [ -f /tmp/babble_tpu_testnet.pids ]; then for sig in TERM KILL; do sort -u /tmp/babble_tpu_testnet.pids | while read pid; do if grep -aq babble_tpu "/proc/$$pid/cmdline" 2>/dev/null; then kill -$$sig -- -$$pid 2>/dev/null; kill -$$sig $$pid 2>/dev/null; fi; done; [ $$sig = TERM ] && sleep 1 || true; done; rm -f /tmp/babble_tpu_testnet.pids; echo "killtestnet: pidfile reaped"; fi
+	-@pkill -9 -f "[b]abble_tpu.cli (run|dummy|signal)" 2>/dev/null; true
+	-@pkill -9 -f "[b]abble_tpu.client.gateway" 2>/dev/null; true
+	@echo "killtestnet: done"
+
 # simsmoke: deterministic virtual-time scenario sweep — 200 seeded
 # chaos x byzantine x churn x overload combinations with invariant
 # checks (no fork / liveness after heal / bounded queues / exactly-once
@@ -159,4 +186,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel

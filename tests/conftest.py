@@ -71,6 +71,12 @@ def pytest_configure(config):
         "healthview: cluster-healthview smokes (live multi-node merge "
         "over HTTP + SLO scoring; make healthsmoke)",
     )
+    config.addinivalue_line(
+        "markers",
+        "client: light-client gateway smokes (streaming subscriptions, "
+        "inclusion proofs, checkpointed replicas, sharded gateway; "
+        "make clientsmoke — docs/clients.md)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,

@@ -1,0 +1,747 @@
+"""Light-client gateway tier (ISSUE-12, docs/clients.md): Merkle
+units, adversarial proof/checkpoint verification, subscription-hub
+ordering + slow-consumer shedding, sim-clock push determinism, and the
+`make clientsmoke` live cluster — 4 TCP validators + 1 sharded gateway
++ a 100-subscriber swarm where every sampled committed transaction's
+``GET /proof/<txid>`` verifies OFFLINE from the validator set alone."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from babble_tpu.client.proofs import TxIndex, build_proof, txid_hex
+from babble_tpu.client.subhub import SubscriptionHub, encode_block_frame
+from babble_tpu.client.swarm import SubscriberClient, SubscriberSwarm
+from babble_tpu.client.verifier import (
+    ProofError,
+    verify_block,
+    verify_checkpoint,
+    verify_proof,
+)
+from babble_tpu.config.config import Config
+from babble_tpu.crypto import merkle
+from babble_tpu.crypto.canonical import b64, unb64
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.dummy.state import State as DummyState
+from babble_tpu.hashgraph.block import Block, BlockBody
+from babble_tpu.hashgraph.store import InmemStore
+from babble_tpu.net.inmem import InmemNetwork
+from babble_tpu.node.node import Node
+from babble_tpu.node.validator import Validator
+from babble_tpu.peers.peer import Peer
+from babble_tpu.peers.peer_set import PeerSet
+from babble_tpu.proxy.proxy import InmemProxy
+
+
+# -- merkle units ------------------------------------------------------------
+
+
+def test_merkle_roots_paths_roundtrip_and_bounds():
+    for n in range(0, 18):
+        txs = [f"leaf {i}".encode() for i in range(n)]
+        root = merkle.merkle_root(txs)
+        if n == 0:
+            assert root == merkle.EMPTY_ROOT
+            continue
+        for i in range(n):
+            path = merkle.merkle_path(txs, i)
+            assert merkle.verify_path(txs[i], i, n, path, root)
+            # wrong index / wrong leaf / out-of-range all fail (the
+            # count itself is pinned by the SIGNED header's TxCount in
+            # verify_proof, not by the path walk)
+            assert not merkle.verify_path(txs[i], (i + 1) % n, n, path, root) or n == 1
+            assert not merkle.verify_path(b"not the leaf", i, n, path, root)
+            assert not merkle.verify_path(txs[i], i, 0, path, root)
+            assert not merkle.verify_path(txs[i], n, n, path, root)
+    with pytest.raises(IndexError):
+        merkle.merkle_path([b"x"], 1)
+    # domain separation: a leaf can never masquerade as an inner node
+    assert merkle.leaf_hash(b"ab") != merkle.node_hash(b"a", b"b")
+    # order sensitivity
+    assert merkle.merkle_root([b"a", b"b"]) != merkle.merkle_root([b"b", b"a"])
+
+
+def test_merkle_tampered_path_rejected():
+    txs = [f"t{i}".encode() for i in range(7)]
+    root = merkle.merkle_root(txs)
+    path = merkle.merkle_path(txs, 3)
+    assert merkle.verify_path(txs[3], 3, 7, path, root)
+    # flip one sibling byte
+    sib, right = path[1]
+    bad = path[:1] + [(bytes([sib[0] ^ 1]) + sib[1:], right)] + path[2:]
+    assert not merkle.verify_path(txs[3], 3, 7, bad, root)
+    # flip a side bit
+    bad2 = path[:1] + [(sib, not right)] + path[2:]
+    assert not merkle.verify_path(txs[3], 3, 7, bad2, root)
+    # truncated / padded paths
+    assert not merkle.verify_path(txs[3], 3, 7, path[:-1], root)
+    assert not merkle.verify_path(txs[3], 3, 7, path + [(b"0" * 32, True)], root)
+
+
+# -- signed header (hashgraph/block.py divergence) ---------------------------
+
+
+def test_blockbody_header_commits_txs_via_merkle_root():
+    body = BlockBody(
+        index=3, round_received=5, timestamp=7,
+        transactions=[b"a", b"b", b"c"],
+    )
+    header = body.header_dict()
+    assert header["TxRoot"] == merkle.merkle_root([b"a", b"b", b"c"])
+    assert header["TxCount"] == 3
+    assert "Transactions" not in header  # proofs never ship the tx list
+    h0 = body.hash()
+    # mutating the tx list changes BOTH the root and the signed hash
+    body.transactions = [b"a", b"b", b"x"]
+    assert body.tx_root() != header["TxRoot"]
+    assert body.hash() != h0
+    # wire form still carries the raw list plus the derived root
+    d = body.to_dict()
+    assert d["Transactions"] and d["TxRoot"] == body.tx_root()
+    # old persisted dicts (no TxRoot) still parse
+    legacy = {k: v for k, v in d.items() if k != "TxRoot"}
+    back = BlockBody.from_dict(legacy)
+    assert back.hash() == body.hash()
+
+
+# -- proof build + adversarial verification ----------------------------------
+
+
+def _signed_block(keys, peer_set, txs):
+    block = Block.new(0, 1, b"frame", peer_set, txs, [], 42)
+    block.body.state_hash = b"state"
+    for k in keys:
+        block.set_signature(block.sign(k))
+    return block
+
+
+@pytest.fixture()
+def proof_fixture(keys3):
+    extra = generate_key()
+    keys = list(keys3) + [extra]
+    peer_set = PeerSet(
+        [Peer(f"addr{i}", k.public_key.hex(), f"v{i}")
+         for i, k in enumerate(keys)]
+    )
+    txs = [f"payload {i}".encode() for i in range(5)]
+    block = _signed_block(keys, peer_set, txs)
+    return keys, peer_set, txs, block
+
+
+def test_proof_verifies_from_validator_set_alone(proof_fixture):
+    keys, peer_set, txs, block = proof_fixture
+    proof = json.loads(json.dumps(build_proof(block, 2)))  # HTTP round-trip
+    res = verify_proof(proof, peer_set)
+    assert res["tx"] == txs[2]
+    assert res["block_index"] == 0 and res["round_received"] == 1
+    assert res["signatures_valid"] == 4
+    # peer-dict form of the validator set works too (the /peers shape)
+    assert verify_proof(proof, [p.to_dict() for p in peer_set.peers])
+    # full-block variant (what subscribers check)
+    assert verify_block(block, peer_set) == 4
+
+
+def test_proof_tampered_merkle_path_rejected(proof_fixture):
+    _, peer_set, txs, block = proof_fixture
+    proof = build_proof(block, 2)
+    step = dict(proof["path"][0])
+    raw = bytearray(unb64(step["hash"]))
+    raw[0] ^= 1
+    step["hash"] = b64(bytes(raw))
+    bad = {**proof, "path": [step] + proof["path"][1:]}
+    with pytest.raises(ProofError) as ei:
+        verify_proof(bad, peer_set)
+    assert ei.value.reason == "bad_merkle_path"
+    # substituted transaction: txid pin catches it first
+    with pytest.raises(ProofError) as ei2:
+        verify_proof({**proof, "tx": b64(b"evil")}, peer_set)
+    assert ei2.value.reason == "txid_mismatch"
+    # consistent txid+tx substitution still dies on the Merkle path
+    evil = {**proof, "tx": b64(b"evil"), "txid": txid_hex(b"evil")}
+    with pytest.raises(ProofError) as ei3:
+        verify_proof(evil, peer_set)
+    assert ei3.value.reason == "bad_merkle_path"
+
+
+def test_proof_forged_or_missing_signatures_rejected(proof_fixture):
+    keys, peer_set, txs, block = proof_fixture
+    proof = build_proof(block, 1)
+    # forged: a signature by a key NOT in the set, claiming a member id
+    outsider = generate_key()
+    member_hex = keys[0].public_key.hex()
+    forged_sig = outsider.sign(block.body.hash())
+    forged = {**proof, "signatures": {member_hex: forged_sig}}
+    with pytest.raises(ProofError) as ei:
+        verify_proof(forged, peer_set)
+    assert ei.value.reason == "not_enough_signatures"
+    # too few real signatures (4 validators → need >= trust_count+1 = 3)
+    one = {**proof, "signatures": {member_hex: proof["signatures"][member_hex]}}
+    with pytest.raises(ProofError):
+        verify_proof(one, peer_set)
+    # a hostile server padding garbage can't inflate the count
+    padded = {**proof, "signatures": {
+        **{member_hex: proof["signatures"][member_hex]},
+        "zz": "junk", outsider.public_key.hex(): forged_sig,
+    }}
+    with pytest.raises(ProofError):
+        verify_proof(padded, peer_set)
+    # header tamper (re-pointing the proof at another block index)
+    # invalidates every signature
+    with pytest.raises(ProofError) as ei2:
+        verify_proof(
+            {**proof, "header": {**proof["header"], "Index": 9}}, peer_set
+        )
+    assert ei2.value.reason == "not_enough_signatures"
+
+
+def test_proof_wrong_validator_set_and_malformed_inputs(proof_fixture):
+    keys, peer_set, txs, block = proof_fixture
+    proof = build_proof(block, 0)
+    stranger = PeerSet(
+        [Peer("x", generate_key().public_key.hex(), "x") for _ in range(4)]
+    )
+    with pytest.raises(ProofError) as ei:
+        verify_proof(proof, stranger)
+    assert ei.value.reason == "wrong_validator_set"
+    for hostile in (None, [], "proof", {}, {"format": "nope"},
+                    {"format": "babble-proof/1"}):
+        with pytest.raises(ProofError):
+            verify_proof(hostile, peer_set)
+    with pytest.raises(ProofError):
+        verify_proof({**proof, "count": 99}, peer_set)
+
+
+def test_txindex_bounds_and_first_commit_wins(proof_fixture):
+    keys, peer_set, txs, block = proof_fixture
+    idx = TxIndex(cap=3)
+    idx.index_block(block)  # 5 txs into a 3-cap index: oldest aged out
+    assert len(idx) == 3 and idx.evictions == 2
+    assert idx.lookup(txid_hex(txs[0])) is None  # aged out == unknown
+    assert idx.lookup(txid_hex(txs[4])) == (0, 4)
+    # duplicate commit of the same payload keeps the FIRST coordinates
+    idx2 = TxIndex()
+    idx2.index_block(block)
+    dup = _signed_block(keys, peer_set, [txs[1]])
+    dup.body.index = 7
+    idx2.index_block(dup)
+    assert idx2.lookup(txid_hex(txs[1])) == (0, 1)
+
+
+# -- checkpoints -------------------------------------------------------------
+
+
+def _mini_cluster(n, conf_extra=None):
+    net = InmemNetwork()
+    transports = [net.new_transport(f"inmem://c{i}") for i in range(n)]
+    keys = [generate_key() for _ in range(n)]
+    peers = PeerSet(
+        [Peer(t.advertise_addr(), k.public_key.hex(), f"c{i}")
+         for i, (t, k) in enumerate(zip(transports, keys))]
+    )
+    nodes, proxies, states = [], [], []
+    for i, k in enumerate(keys):
+        conf = Config(
+            heartbeat_timeout=0.01, slow_heartbeat_timeout=0.2,
+            moniker=f"c{i}", log_level="error", **(conf_extra or {}),
+        )
+        st = DummyState()
+        pr = InmemProxy(st)
+        node = Node(conf, Validator(k, f"c{i}"), peers, peers,
+                    InmemStore(conf.cache_size), transports[i], pr)
+        node.init()
+        nodes.append(node)
+        proxies.append(pr)
+        states.append(st)
+    return nodes, proxies, states, peers
+
+
+def _wait(pred, deadline_s=60.0, msg="condition"):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.03)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_checkpoint_export_verify_and_bad_signature():
+    nodes, proxies, states, peers = _mini_cluster(3)
+    try:
+        for n in nodes:
+            n.run_async()
+        assert proxies[0].submit_tx(b"cp tx") == "accepted"
+        _wait(lambda: all(b"cp tx" in s.committed_txs for s in states),
+              msg="commit")
+
+        cp = None
+        deadline = time.monotonic() + 60.0
+        while cp is None:
+            try:
+                cp = nodes[0].get_checkpoint()
+            except ValueError:  # no anchor block yet
+                assert time.monotonic() < deadline, "anchor never sealed"
+                time.sleep(0.05)
+        cp = json.loads(json.dumps(cp))  # HTTP round-trip
+        block, frame = verify_checkpoint(cp, peers)
+        assert block.frame_hash() == frame.hash()
+
+        # tampered signatures → rejected once the valid count drops
+        # below the more-than-one-third bar (tamper every signature:
+        # forging ONE of three must still verify, finality only needs
+        # a third of the set honest)
+        bad = json.loads(json.dumps(cp))
+        bad["block"]["Signatures"] = {
+            who: sig[:-2] + ("11" if not sig.endswith("11") else "22")
+            for who, sig in bad["block"]["Signatures"].items()
+        }
+        with pytest.raises(ProofError) as eis:
+            verify_checkpoint(bad, peers)
+        assert eis.value.reason == "not_enough_signatures"
+        # tampered frame → the FrameHash binding catches it
+        bad2 = json.loads(json.dumps(cp))
+        bad2["frame"]["Timestamp"] = bad2["frame"]["Timestamp"] + 1
+        with pytest.raises(ProofError) as ei:
+            verify_checkpoint(bad2, peers)
+        assert ei.value.reason in ("bad_frame_hash", "bad_checkpoint")
+        # wrong trust root → rejected
+        stranger = PeerSet(
+            [Peer("x", generate_key().public_key.hex(), "x")
+             for _ in range(3)]
+        )
+        with pytest.raises(ProofError):
+            verify_checkpoint(cp, stranger)
+        with pytest.raises(ProofError):
+            verify_checkpoint({"format": "junk"}, peers)
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+# -- subscription hub: ordering + shedding (unit, fake source) ---------------
+
+
+class _FakeBlock:
+    def __init__(self, i, fill=0):
+        self.i = i
+        self._fill = "x" * fill
+
+    def index(self):
+        return self.i
+
+    def to_dict(self):
+        return {
+            "Body": {"Index": self.i, "Transactions": [],
+                     "Fill": self._fill},
+            "Signatures": {},
+        }
+
+
+def test_subhub_in_order_no_gaps_and_backfill():
+    blocks = {}
+    hub = SubscriptionHub(
+        "127.0.0.1:0", blocks.get, moniker="unit", queue_frames=8
+    )
+    addr = hub.listen()
+    try:
+        # backfill subscriber from 0 plus a live-only subscriber
+        early = SubscriberClient(addr, start=0)
+        for i in range(6):
+            blocks[i] = _FakeBlock(i)
+            hub.publish(i)
+        got = [early.recv(timeout=5)["block"]["Body"]["Index"]
+               for _ in range(6)]
+        assert got == list(range(6))
+        live = SubscriberClient(addr, start=-1)
+        assert live.hello["next"] == 6  # live tail skips history
+        blocks[6] = _FakeBlock(6)
+        hub.publish(6)
+        assert live.recv(timeout=5)["block"]["Body"]["Index"] == 6
+        assert early.recv(timeout=5)["block"]["Body"]["Index"] == 6
+        # unsealed gap: publishing 8 while 7 is missing pushes NOTHING
+        blocks[8] = _FakeBlock(8)
+        hub.publish(8)
+        with pytest.raises((socket.timeout, TimeoutError)):
+            live.recv(timeout=0.6)
+        blocks[7] = _FakeBlock(7)  # 7 seals later → 7 then 8, in order
+        assert live.recv(timeout=5)["block"]["Body"]["Index"] == 7
+        assert live.recv(timeout=5)["block"]["Body"]["Index"] == 8
+        stats = hub.stats()
+        assert stats["subscribers"] == 2 and stats["shed"] == 0
+        early.close()
+        live.close()
+    finally:
+        hub.close()
+
+
+def test_subhub_sheds_stalled_subscriber_without_hurting_healthy():
+    blocks = {}
+    hub = SubscriptionHub(
+        "127.0.0.1:0", blocks.get, moniker="unit",
+        queue_frames=4, stall_timeout_s=0.8, sndbuf=8192,
+    )
+    addr = hub.listen()
+    try:
+        healthy = SubscriberClient(addr, start=0)
+        # stalled bait: subscribes, then never reads (tiny rcvbuf so the
+        # kernel can't soak the stream)
+        host, port_s = addr.rsplit(":", 1)
+        bait = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        bait.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        bait.connect((host, int(port_s)))
+        body = json.dumps({"type": "subscribe", "from": 0}).encode()
+        bait.sendall(struct.pack(">I", len(body)) + body)
+        _wait(lambda: hub.stats()["subscribers"] == 2, 10, "both subscribed")
+
+        # fat blocks so a few of them overflow the bait's buffers
+        for i in range(40):
+            blocks[i] = _FakeBlock(i, fill=8192)
+            hub.publish(i)
+        got = [healthy.recv(timeout=10)["block"]["Body"]["Index"]
+               for i in range(40)]
+        assert got == list(range(40)), "healthy subscriber saw gap/disorder"
+        _wait(lambda: hub.stats()["shed"] == 1, 20, "stalled subscriber shed")
+        # shed counter bumps just before the subscriber list is pruned
+        # (hub-loop-internal ordering; stats reads are lock-free)
+        _wait(lambda: hub.stats()["subscribers"] == 1, 5,
+              "bait gone, healthy alive")
+        assert hub.stats()["shed_reasons"].get("stalled", 0) == 1
+        # healthy stream still live after the shed
+        blocks[40] = _FakeBlock(40)
+        hub.publish(40)
+        assert healthy.recv(timeout=5)["block"]["Body"]["Index"] == 40
+        healthy.close()
+        bait.close()
+    finally:
+        hub.close()
+
+
+def test_subhub_survives_hostile_frames():
+    """A well-framed but non-object JSON body (valid JSON, not a dict)
+    must shed THAT client as a protocol error — never escape into the
+    selector loop and tear the hub down (live-reproduced regression)."""
+    from babble_tpu.client.subhub import parse_frames
+
+    blocks = {0: _FakeBlock(0)}
+    hub = SubscriptionHub("127.0.0.1:0", blocks.get, moniker="unit")
+    addr = hub.listen()
+    try:
+        host, port_s = addr.rsplit(":", 1)
+        for hostile in (b"[1,2,3]", b"42", b'"subscribe"', b"not json"):
+            s = socket.create_connection((host, int(port_s)), timeout=5)
+            s.sendall(struct.pack(">I", len(hostile)) + hostile)
+            s.close()
+        _wait(lambda: hub.stats()["shed_reasons"].get("protocol", 0) >= 3,
+              10, "hostile clients shed as protocol errors")
+        # the hub is still alive and serves a legitimate subscriber
+        assert hub._thread.is_alive()
+        good = SubscriberClient(addr, start=0)
+        hub.publish(0)
+        assert good.recv(timeout=5)["block"]["Body"]["Index"] == 0
+        good.close()
+    finally:
+        hub.close()
+    # the client-side decoder rejects non-object frames the same way
+    # (covers SubscriberClient / ReadReplica / SubscriberSwarm at once)
+    bad = bytearray(struct.pack(">I", 7) + b"[1,2,3]")
+    with pytest.raises(ValueError):
+        parse_frames(bad)
+
+
+# -- deterministic sim-clock subscription digests ----------------------------
+
+
+@pytest.mark.sim
+def test_same_seed_subscription_push_digest_byte_identical():
+    """The frames a hub would push are a pure function of the committed
+    chain: two same-seed sim runs yield byte-identical push digests
+    (encode_block_frame without the wall stamp), a different seed
+    differs. This pins the whole pipeline — deterministic consensus →
+    deterministic block bodies/signatures → deterministic stream."""
+    from babble_tpu.crypto.keys import set_deterministic_signing
+    from babble_tpu.sim.harness import SimCluster
+    from babble_tpu.sim.scheduler import SimScheduler
+
+    def run(seed: int) -> str:
+        prev = set_deterministic_signing(True)
+        cluster = None
+        try:
+            sch = SimScheduler(seed)
+            cluster = SimCluster(sch, 4, heartbeat_s=0.05)
+            cluster.start()
+            txrng = sch.rng("txmix")
+            for k in range(10):
+                sch.at(0.05 + 0.06 * k,
+                       lambda: cluster.submit_auto(txrng), "tx")
+            sch.run_until(3.0)
+            node = cluster.nodes[0]
+            h = hashlib.sha256()
+            for bi in range(node.get_last_block_index() + 1):
+                h.update(encode_block_frame(node.get_block(bi)))
+            assert node.get_last_block_index() >= 0, "nothing committed"
+            return h.hexdigest()
+        finally:
+            try:
+                if cluster is not None:
+                    cluster.shutdown()
+            finally:
+                set_deterministic_signing(prev)
+
+    d1, d2, d3 = run(77), run(77), run(78)
+    assert d1 == d2
+    assert d1 != d3
+
+
+# -- the clientsmoke: live cluster + gateway + 100-subscriber swarm ---------
+
+
+@pytest.mark.client
+def test_clientsmoke_cluster_gateway_swarm_proofs():
+    """`make clientsmoke`: 4 TCP validators (each with a
+    SubscriptionHub + HTTP service) + 1 sharded gateway + 100
+    subscribers (5 deliberately stalled). Every sampled accepted
+    transaction's GET /proof/<txid> verifies offline from the validator
+    set alone; pushed blocks arrive in order with zero gaps on every
+    healthy subscriber; the stalled subscribers are shed while the
+    healthy ones keep receiving; a checkpoint spins a verifying read
+    replica that serves proofs itself."""
+    from babble_tpu.client.gateway import Gateway
+    from babble_tpu.client.replica import ReadReplica
+    from babble_tpu.dummy.socket_client import DummySocketClient
+    from babble_tpu.net.tcp import TCPTransport
+    from babble_tpu.proxy.socket_proxy import JsonRpcClient, SocketAppProxy
+    from babble_tpu.service.service import Service
+
+    n_nodes, n_subs, n_stalled = 4, 100, 5
+    transports = [
+        TCPTransport("127.0.0.1:0", max_pool=2, timeout=5.0)
+        for _ in range(n_nodes)
+    ]
+    for t in transports:
+        t.listen()
+    keys = [generate_key() for _ in range(n_nodes)]
+    peers = PeerSet(
+        [Peer(t.advertise_addr(), k.public_key.hex(), f"v{i}")
+         for i, (t, k) in enumerate(zip(transports, keys))]
+    )
+    # nodes 0/1 take app submissions over the real socket proxy (the
+    # gateway's forward targets); 2/3 use in-mem proxies
+    sock_proxies, dummies = [], []
+    for _ in range(2):
+        sp = SocketAppProxy("127.0.0.1:0", "127.0.0.1:0")
+        dc = DummySocketClient("127.0.0.1:0", sp.addr)
+        sp.set_client_addr(dc.addr)
+        sock_proxies.append(sp)
+        dummies.append(dc)
+    nodes, proxies, states, services = [], [], [], []
+    try:
+        for i, k in enumerate(keys):
+            conf = Config(
+                heartbeat_timeout=0.01, slow_heartbeat_timeout=0.2,
+                moniker=f"v{i}", log_level="error",
+                client_listen="127.0.0.1:0",
+                sub_queue_frames=32, sub_stall_timeout_s=3.0,
+                sub_sndbuf=8192,
+            )
+            if i < 2:
+                pr, st = sock_proxies[i], dummies[i].state
+            else:
+                st = DummyState()
+                pr = InmemProxy(st)
+            node = Node(conf, Validator(k, f"v{i}"), peers, peers,
+                        InmemStore(conf.cache_size), transports[i], pr)
+            node.init()
+            nodes.append(node)
+            proxies.append(pr)
+            states.append(st)
+            srv = Service("127.0.0.1:0", node, logger=None)
+            srv.serve_async()
+            services.append(srv)
+        for n in nodes:
+            n.run_async()
+
+        gw = Gateway(
+            [sp.addr for sp in sock_proxies],
+            nodes[2].client_hub.bind_addr,
+            [p.to_dict() for p in peers.peers],
+            listen="127.0.0.1:0", sub_listen="127.0.0.1:0",
+            http_addr="127.0.0.1:0", shards=2, processes=False,
+        )
+        gw.start()
+
+        swarm = SubscriberSwarm(
+            [n.client_hub.bind_addr for n in nodes],
+            n_subs, start=0, stall_frac=n_stalled / n_subs,
+        )
+        swarm.start_all()
+        assert swarm.connect_errors == 0
+        # n_subs swarm members + the gateway replica's own upstream
+        # subscription (it rides nodes[2]'s hub like any other client)
+        _wait(
+            lambda: sum(
+                h.stats()["subscribers"]
+                for h in (n.client_hub for n in nodes)
+            ) == n_subs + 1,
+            20, "all subscribers attached",
+        )
+
+        # load: ~1 KiB payloads so the stalled subscribers' buffers
+        # overflow within the run; submitted through the GATEWAY (its
+        # sharded admission pipeline) and directly at validators
+        gw_client = JsonRpcClient(gw.listen_addr)
+        accepted = []
+        for i in range(60):
+            tx = (f"gw tx {i} " + "x" * 1000).encode()
+            v = gw_client.call(
+                "Babble.SubmitTx", base64.b64encode(tx).decode("ascii")
+            )
+            assert v == "accepted", (i, v)
+            accepted.append(tx)
+        for i in range(40):
+            tx = (f"direct tx {i} " + "y" * 1000).encode()
+            if proxies[2 + (i % 2)].submit_tx(tx) == "accepted":
+                accepted.append(tx)
+        # a duplicate through the gateway sheds at the edge
+        assert gw_client.call(
+            "Babble.SubmitTx",
+            base64.b64encode(accepted[0]).decode("ascii"),
+        ) in ("duplicate", "already_committed")
+
+        _wait(
+            lambda: all(
+                all(tx in st.committed_txs for tx in accepted)
+                for st in states
+            ),
+            120, "all accepted txs committed everywhere",
+        )
+
+        # every sampled committed tx yields an offline-verifiable proof
+        # over live HTTP, from any validator
+        sample = accepted[:: max(1, len(accepted) // 12)][:12]
+        for j, tx in enumerate(sample):
+            tid = txid_hex(tx)
+            srv = services[j % n_nodes]
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{srv.bind_addr}/proof/{tid}", timeout=5.0
+                    ) as r:
+                        proof = json.loads(r.read())
+                    res = verify_proof(proof, peers)
+                    assert res["tx"] == tx
+                    break
+                except (ProofError, urllib.error.HTTPError, OSError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+        # unknown txid → clean 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{services[0].bind_addr}/proof/{'0' * 64}",
+                timeout=5.0,
+            )
+        assert ei.value.code == 404
+
+        # the gateway's own replica serves the same proof, verified
+        gw_tid = txid_hex(accepted[0])
+        _wait(lambda: gw.get_proof(gw_tid) is not None, 60,
+              "gateway replica indexed the tx")
+        verify_proof(gw.get_proof(gw_tid), peers)
+        assert gw.replica.rejected_blocks == 0
+
+        # stalled subscribers shed; healthy ones saw EVERY sealed block
+        # in order with zero gaps, on every hub
+        _wait(
+            lambda: sum(
+                n.client_hub.stats()["shed"] for n in nodes
+            ) >= n_stalled,
+            60, "stalled subscribers shed",
+        )
+        time.sleep(1.0)  # let the stream tail drain to the swarm
+        st = swarm.stats()
+        assert st["gaps"] == 0, st
+        healthy = swarm.healthy()
+        # Under full-suite load the swarm's single reader thread can
+        # itself fall behind the stall timeout, making a few healthy
+        # members look like slow consumers — the hub shedding those is
+        # CORRECT behavior, so tolerate a small number while requiring
+        # the overwhelming majority alive and gap-free.
+        alive = [m for m in healthy if not m.closed]
+        assert len(alive) >= 0.9 * len(healthy), (
+            f"{len(healthy) - len(alive)} healthy subscribers dropped"
+        )
+        blocks_per_sub = {m.blocks for m in alive}
+        assert min(blocks_per_sub) > 0
+        # every live healthy subscriber of the SAME hub saw the same
+        # stream (a stalled peer must not skew delivery)
+        by_hub = {}
+        for m in alive:
+            by_hub.setdefault(m.idx % n_nodes, set()).add(m.blocks)
+        for hub_idx, counts in by_hub.items():
+            assert max(counts) - min(counts) <= 1, (
+                f"hub {hub_idx}: uneven delivery {counts} — a stalled "
+                "peer delayed healthy subscribers"
+            )
+
+        # checkpoint → instant verifying read replica → proof
+        with urllib.request.urlopen(
+            f"http://{services[0].bind_addr}/checkpoint", timeout=5.0
+        ) as r:
+            cp = json.loads(r.read())
+        block, _ = verify_checkpoint(cp, peers)
+        replica = ReadReplica(
+            nodes[3].client_hub.bind_addr,
+            [p.to_dict() for p in peers.peers],
+            checkpoint=cp, http_addr="127.0.0.1:0",
+        )
+        assert replica.last_verified == block.index()
+        replica.start()
+        try:
+            cp_txs = [
+                t for t in block.transactions() if t in accepted
+            ]
+            probe = cp_txs[0] if cp_txs else accepted[0]
+            _wait(
+                lambda: replica.get_proof(txid_hex(probe)) is not None,
+                60, "replica serves the proof",
+            )
+            with urllib.request.urlopen(
+                f"http://{replica.http_addr}/proof/{txid_hex(probe)}",
+                timeout=5.0,
+            ) as r:
+                verify_proof(json.loads(r.read()), peers)
+            assert replica.rejected_blocks == 0
+        finally:
+            replica.close()
+
+        # instruments moved (satellite: catalog + healthview surface)
+        with urllib.request.urlopen(
+            f"http://{services[0].bind_addr}/metrics", timeout=5.0
+        ) as r:
+            metrics = r.read().decode()
+        for name in ("client_subscribers", "client_pushed_blocks_total",
+                     "client_shed_subscribers_total",
+                     "client_proofs_served_total"):
+            assert name in metrics
+        snap = nodes[0].get_stats_snapshot()
+        assert snap["client_pushed_blocks"] > 0
+        assert snap["client_txindex_entries"] > 0
+
+        swarm.stop()
+        gw.close()
+    finally:
+        for srv in services:
+            srv.shutdown()
+        for n in nodes:
+            n.shutdown()
+        for dc in dummies:
+            dc.close()

@@ -269,7 +269,9 @@ def test_commit_completes_before_block_is_published():
     assert all(seen), "a block was published before its commit completed"
     # and the published block's cached hash is coherent with its content
     blk = core.hg.store.get_block(core.hg.store.last_block_index())
-    assert blk.body.hash() == sha256(canonical_dumps(blk.body.to_dict()))
+    # the signed digest covers the HEADER form (transactions committed
+    # via TxRoot/TxCount — docs/parity.md, ISSUE-12)
+    assert blk.body.hash() == sha256(canonical_dumps(blk.body.header_dict()))
 
 
 def test_block_body_hash_cache_survives_racing_invalidation():
@@ -289,4 +291,5 @@ def test_block_body_hash_cache_survives_racing_invalidation():
     from babble_tpu.crypto.canonical import canonical_dumps
     from babble_tpu.crypto.hashing import sha256
 
-    assert h2 == sha256(canonical_dumps(body.to_dict()))
+    # fresh recompute matches the signed HEADER form (docs/parity.md)
+    assert h2 == sha256(canonical_dumps(body.header_dict()))
