@@ -50,16 +50,23 @@ _RUN_FLAGS = {
     "accelerator": ("accelerator", bool),
     "accelerator_mesh": ("accelerator_mesh", int),
     "transport": ("transport", str),
+    # lint: allow(knobs: toml-only; the CLI route is the negative-polarity --no-gossip-pipeline)
+    "gossip_pipeline": ("gossip_pipeline", bool),
     "gossip_pipeline_depth": ("gossip_pipeline_depth", int),
-    "adaptive_gossip": ("adaptive_gossip", bool),  # toml only; CLI: --no-adaptive
+    # lint: allow(knobs: toml-only; the CLI route is the negative-polarity --no-adaptive)
+    "adaptive_gossip": ("adaptive_gossip", bool),
     "gossip_max_fanout": ("gossip_max_fanout", int),
     "selfevent_burst": ("selfevent_burst", int),
+    "fast_forward_deadline": ("fast_forward_deadline", float),
+    "join_backoff_cap": ("join_backoff_cap", float),
     "mempool_max_txs": ("mempool_max_txs", int),
     "mempool_max_bytes": ("mempool_max_bytes", int),
     "mempool_overflow": ("mempool_overflow", str),
     "mempool_event_max_txs": ("mempool_event_max_txs", int),
     "mempool_event_max_bytes": ("mempool_event_max_bytes", int),
+    "mempool_committed_lru": ("mempool_committed_lru", int),
     "mempool_rate": ("mempool_rate", float),
+    "mempool_burst": ("mempool_burst", float),
     "submit_batch": ("submit_batch", int),
     "sentry_threshold": ("sentry_threshold", float),
     "sentry_quarantine": ("sentry_quarantine_s", float),
@@ -109,10 +116,13 @@ def _build_config(args: argparse.Namespace) -> Config:
         v = getattr(args, flag, None)
         if v is not None and v is not False:
             layered[attr] = v
-    # negative-polarity flag (the store_true pattern above can only turn
-    # booleans ON): --no-adaptive pins the fixed two-speed timer
+    # negative-polarity flags (the store_true pattern above can only turn
+    # booleans ON): --no-adaptive pins the fixed two-speed timer,
+    # --no-gossip-pipeline keeps inbound syncs inline on handler threads
     if getattr(args, "no_adaptive", False):
         layered["adaptive_gossip"] = False
+    if getattr(args, "no_gossip_pipeline", False):
+        layered["gossip_pipeline"] = False
     return Config(**layered)
 
 
@@ -187,7 +197,7 @@ def cmd_signal(args: argparse.Namespace) -> int:
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
     while not stop["flag"]:
-        _time.sleep(0.2)
+        _time.sleep(0.2)  # lint: allow(clock: signal-server daemon wait loop; CLI entry point, never under sim)
     return 0
 
 
@@ -229,7 +239,7 @@ def cmd_dummy(args: argparse.Namespace) -> int:
     try:
         if args.no_repl:
             while not stop["flag"]:
-                _time.sleep(0.2)
+                _time.sleep(0.2)  # lint: allow(clock: dummy-app daemon wait loop; CLI entry point, never under sim)
         else:
             while not stop["flag"]:
                 line = sys.stdin.readline()
@@ -312,9 +322,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="bounded insert-queue depth of the inbound-sync pipeline",
     )
     run.add_argument(
+        "--no-gossip-pipeline", dest="no_gossip_pipeline",
+        action="store_true",
+        help="disable the staged inbound-sync pipeline: decode, verify "
+        "and insert run inline on handler threads (the pre-pipeline "
+        "shape; docs/gossip.md)",
+    )
+    run.add_argument(
         "--no-adaptive", dest="no_adaptive", action="store_true",
         help="disable the adaptive gossip scheduler: fixed two-speed "
         "heartbeat, one partner per tick (same as BABBLE_ADAPT=0)",
+    )
+    run.add_argument(
+        "--fast-forward-deadline", dest="fast_forward_deadline",
+        type=float, default=None,
+        help="total budget in seconds for the catching-up node's "
+        "fast-forward poll loop (docs/robustness.md)",
+    )
+    run.add_argument(
+        "--join-backoff-cap", dest="join_backoff_cap", type=float,
+        default=None,
+        help="cap in seconds on the joining node's retry backoff",
     )
     run.add_argument(
         "--gossip-max-fanout", dest="gossip_max_fanout", type=int,
@@ -349,8 +377,18 @@ def build_parser() -> argparse.ArgumentParser:
         type=int, default=None, help="max client tx bytes per self-event",
     )
     run.add_argument(
+        "--mempool-committed-lru", dest="mempool_committed_lru", type=int,
+        default=None,
+        help="committed-transaction-hash LRU size (turns retries of "
+        "committed txs into `already_committed`)",
+    )
+    run.add_argument(
         "--mempool-rate", dest="mempool_rate", type=float, default=None,
         help="token-bucket admission rate in tx/s (0 = unlimited)",
+    )
+    run.add_argument(
+        "--mempool-burst", dest="mempool_burst", type=float, default=None,
+        help="token-bucket burst size in txs (0 = one second's worth)",
     )
     run.add_argument(
         "--submit-batch", dest="submit_batch", type=int, default=None,

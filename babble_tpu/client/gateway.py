@@ -414,7 +414,7 @@ def main(argv=None) -> int:
     _signal.signal(_signal.SIGINT, _stop)
     _signal.signal(_signal.SIGTERM, _stop)
     while not stop["flag"]:
-        _time.sleep(0.2)
+        _time.sleep(0.2)  # lint: allow(clock: gateway daemon wait loop; operator tool, never under sim)
     gw.close()
     return 0
 

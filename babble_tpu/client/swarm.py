@@ -180,7 +180,7 @@ class SubscriberSwarm:
         except OSError:
             self._close(m)
             return
-        now = time.time()
+        now = time.time()  # lint: allow(clock: client-side latency measurement tool; never runs under sim)
         try:
             frames = parse_frames(m.buf)
         except ValueError:
@@ -201,7 +201,7 @@ class SubscriberSwarm:
                 if isinstance(ts, (int, float)):
                     if len(m.latencies) >= self.latency_sample:
                         m.latencies[
-                            random.randrange(self.latency_sample)
+                            random.randrange(self.latency_sample)  # lint: allow(clock: reservoir sampling in a client-side tool)
                         ] = now - ts
                     else:
                         m.latencies.append(now - ts)

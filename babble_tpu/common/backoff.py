@@ -29,6 +29,7 @@ def jittered_backoff(
     if attempt < 1:
         return 0.0
     u = (rng.uniform(-1.0, 1.0) if rng is not None
+         # lint: allow(clock: production fallback; sim callers always inject a seeded rng)
          else random.uniform(-1.0, 1.0))
     nominal = base_s * (2.0 ** min(attempt - 1, 32))
     return min(cap_s, nominal * (1.0 + jitter * u))

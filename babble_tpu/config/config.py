@@ -204,10 +204,12 @@ class Config:
     # object. None -> the process wall clock. The deterministic
     # simulation engine (babble_tpu.sim, docs/simulation.md) injects a
     # SimClock here so whole fault scenarios run in virtual time.
+    # lint: allow(knobs: runtime injection point, not an operator knob)
     clock: object = None
     # Seed for the node's internal RNG streams (peer-selector pick
     # weighting). None -> OS entropy (production). The sim harness sets
     # it so gossip partner choice is a pure function of the master seed.
+    # lint: allow(knobs: runtime injection point, not an operator knob)
     sim_seed: object = None
 
     # TPU acceleration: route batch verification and the DAG consensus

@@ -33,6 +33,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
+
+from ..common.timed_lock import named_lock
 from typing import Dict, List, Optional
 
 logger = logging.getLogger("babble_tpu.hashgraph.sweep_batcher")
@@ -71,7 +73,8 @@ class SweepBatcher:
     DECAY_WAVES = 24
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # Named for the BABBLE_LOCKCHECK order recorder (lockcheck.py).
+        self._lock = named_lock("batcher")
         self._pending: List[Ticket] = []
         self._work = threading.Event()
         self._compiling: set = set()

@@ -34,6 +34,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from ..common.lru import LRU
+from ..common.timed_lock import named_lock
 from ..crypto.hashing import sha256
 from .ratelimit import TokenBucket
 
@@ -84,7 +85,11 @@ class Mempool:
         self.event_max_txs = event_max_txs
         self.event_max_bytes = event_max_bytes
         self._clock = clock
-        self._lock = threading.Lock()
+        # Named for the BABBLE_LOCKCHECK acquisition-order recorder
+        # (common/lockcheck.py): Core drains/requeues under the core
+        # lock, so the core->mempool edge is part of the audited model;
+        # a raw C lock when the recorder is off (hot admission path).
+        self._lock = named_lock("mempool")
         # Commit-latency telemetry (attach_telemetry): per-hash admit and
         # drain timestamps feed commit_latency_seconds and the
         # tx_stage_seconds{mempool_wait,consensus} histograms. The dicts

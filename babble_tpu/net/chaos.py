@@ -431,14 +431,18 @@ class Nemesis:
         return self
 
     def _run(self) -> None:
-        t0 = time.monotonic()
+        # The Nemesis thread is live-soak-only wall time: the sim engine
+        # never calls start() — it schedules the same steps as virtual-
+        # time scheduler events (sim/harness.py), so these reads can't
+        # perturb replay determinism.
+        t0 = time.monotonic()  # lint: allow(clock: live-soak nemesis thread; sim schedules steps as events)
         try:
             for step in self.steps:
                 while not self._stop.is_set():
-                    remaining = t0 + step.at - time.monotonic()
+                    remaining = t0 + step.at - time.monotonic()  # lint: allow(clock: live-soak nemesis thread)
                     if remaining <= 0:
                         break
-                    time.sleep(min(remaining, 0.05))
+                    time.sleep(min(remaining, 0.05))  # lint: allow(clock: live-soak nemesis thread)
                 if self._stop.is_set():
                     return
                 try:

@@ -73,7 +73,7 @@ class InmemNetwork:
     def request(self, src: str, target: str, command, timeout: float = 5.0):
         t = self.route(src, target, timeout)
         rpc = RPC(command)
-        rpc.recv_ts = time.time()  # arrival stamp for trace attribution
+        rpc.recv_ts = time.time()  # lint: allow(clock: recv_ts is a real arrival stamp; SimTransport leaves it None)
         t.consumer().put(rpc)
         try:
             result, error = rpc.wait(timeout=timeout)

@@ -209,7 +209,7 @@ class TCPTransport:
                     continue
                 command = req_cls.from_dict(json.loads(payload))
                 rpc = RPC(command)
-                rpc.recv_ts = time.time()  # arrival stamp (trace attribution)
+                rpc.recv_ts = time.time()  # lint: allow(clock: recv_ts is a real-wire arrival stamp; sim uses SimTransport)
                 self._consumer.put(rpc)
                 # Joins park on a consensus promise in the handler; give the
                 # node's own join deadline room to fire first (+2 s margin).

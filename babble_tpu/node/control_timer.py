@@ -15,7 +15,7 @@ import threading
 
 
 class ControlTimer:
-    def __init__(self) -> None:
+    def __init__(self, rng=None) -> None:
         self.tick = threading.Event()
         self._cond = threading.Condition()
         self._interval: float = 0.0
@@ -23,6 +23,17 @@ class ControlTimer:
         self._shutdown = False
         self.is_set = False
         self._thread: threading.Thread | None = None
+        # Jitter source: the node injects Config.seeded_rng("control_timer")
+        # so the gossip cadence is a pure function of the master seed —
+        # a global-random draw here made same-seed sim replays diverge
+        # on the JOINING path (docs/simulation.md determinism contract).
+        # None (production) falls back to the process-global module.
+        self._rng = rng if rng is not None else random
+
+    def _jitter(self, interval: float) -> float:
+        """Random interval in [min, 2*min) — the reference's jittered
+        heartbeat, drawn from the injected stream."""
+        return interval + self._rng.random() * interval
 
     def run(self, init_interval: float) -> None:
         """Start the timer loop in the background
@@ -40,8 +51,7 @@ class ControlTimer:
                     self.is_set = False
                     return
                 interval = self._interval
-                # random interval in [min, 2*min)
-                wait = interval + random.random() * interval
+                wait = self._jitter(interval)
                 self._armed = False
                 notified = self._cond.wait(timeout=wait)
                 if self._shutdown:

@@ -40,6 +40,7 @@ from ..obs.provenance import parse_ctx
 from ..peers.peer import Peer
 from ..peers.peer_set import PeerSet
 from ..common.latency import LatencyRecorder
+from ..common import lockcheck
 from ..common.timed_lock import TimedLock
 from ..proxy.proxy import AppProxy
 from .control_timer import ControlTimer
@@ -105,6 +106,7 @@ class Node(StateManager):
         self.core_lock = TimedLock(
             observer=self.telemetry.lock_wait_observer,
             clock=self.clock.perf_counter,
+            name="core",  # BABBLE_LOCKCHECK order recorder (lockcheck.py)
         )
         self.trans = trans
         self.proxy = proxy
@@ -115,7 +117,12 @@ class Node(StateManager):
         # fallback for proxies predating verdicts.
         if hasattr(proxy, "set_submit_handler"):
             proxy.set_submit_handler(self._admit_transaction)
-        self.control_timer = ControlTimer()
+        # Jitter stream for the heartbeat timer: seeded under sim so the
+        # gossip cadence replays byte-identically (babblelint clock pass
+        # caught the old global-random draw; docs/static_analysis.md).
+        self.control_timer = ControlTimer(
+            rng=conf.seeded_rng("control_timer", validator.id())
+        )
         self.shutdown_event = threading.Event()
         self.suspend_event = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -567,6 +574,15 @@ class Node(StateManager):
                     self.core_lock.wait_ms_total(), 1
                 ),
                 "lock_acquisitions": self.core_lock.acquisitions,
+                # BABBLE_LOCKCHECK acquisition-order recorder (process-
+                # wide; empty list / 0 while the recorder is disarmed).
+                # Any inversion is a latent deadlock — the lockcheck'd
+                # chaos and sim CI legs assert this stays 0
+                # (docs/static_analysis.md §Lock model).
+                "lock_order_edges": lockcheck.RECORDER.edge_list(),
+                "lock_order_inversions": len(
+                    lockcheck.RECORDER.inversions()
+                ),
                 "wire_cache_hits": WIRE_CACHE.hits,
                 "wire_cache_misses": WIRE_CACHE.misses,
                 "norm_cache_hits": NORM_CACHE.hits,

@@ -53,6 +53,8 @@ import queue
 import threading
 from typing import Optional
 
+from ..common.timed_lock import named_lock
+
 
 class _PullSync:
     """Stand-in for the RPC command on a pulled batch: just the fields
@@ -71,7 +73,8 @@ class SyncPipeline:
         self.node = node
         self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=max(1, queue_cap))
         self._submit_timeout = submit_timeout
-        self._lock = threading.Lock()
+        # Named for the BABBLE_LOCKCHECK order recorder (lockcheck.py).
+        self._lock = named_lock("pipeline")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # Signaled by the inserter after each drained item: soft-capped
@@ -299,13 +302,16 @@ class SyncPipeline:
         }
 
     def wait_idle(self, timeout: float = 5.0) -> bool:
-        """Test/shutdown helper: block until nothing is in flight."""
-        import time as _time
+        """Test/shutdown helper: block until nothing is in flight.
+        Deliberately WALL time: the pipeline is auto-disabled under an
+        injected sim clock, and its workers are real threads — a virtual
+        deadline would never advance while polling them."""
+        from ..common.clock import WALL
 
-        deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
+        deadline = WALL.monotonic() + timeout
+        while WALL.monotonic() < deadline:
             with self._lock:
                 if self.inflight == 0:
                     return True
-            _time.sleep(0.005)
+            WALL.sleep(0.005)
         return False

@@ -57,6 +57,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..common.clock import WALL
+from ..common.timed_lock import named_lock
 from ..config.config import (
     DEFAULT_SENTRY_DECAY_HALFLIFE_S,
     DEFAULT_SENTRY_QUARANTINE_S,
@@ -126,13 +128,19 @@ class EquivocationProof:
         def pack(e: Event) -> dict:
             return _jsonable({"Body": e.body.to_dict(), "Signature": e.signature})
 
+        # The production caller (Sentry.observe_rejection) always passes
+        # its node clock's wall time, so proofs stamp virtual time under
+        # sim and same-seed replays export byte-identical evidence. The
+        # bare default — a raw time.time() before the babblelint clock
+        # pass caught it — now routes through the WALL abstraction and
+        # only serves clockless direct callers (tests, tools).
         return EquivocationProof(
             creator=incoming.creator(),
             index=incoming.index(),
             event_a=pack(existing),
             event_b=pack(incoming),
             observed_at=int(
-                observed_at if observed_at is not None else time.time()
+                observed_at if observed_at is not None else WALL.time()
             ),
         )
 
@@ -209,7 +217,10 @@ class Sentry:
         self.weights = dict(DEFAULT_WEIGHTS if weights is None else weights)
         self._clock = clock
         self._wall_clock = wall_clock
-        self._lock = threading.Lock()
+        # Named for the BABBLE_LOCKCHECK acquisition-order recorder:
+        # ingest rejections score under the core lock, so the
+        # core->sentry edge is part of the audited model.
+        self._lock = named_lock("sentry")
         self._records: Dict[int, _PeerRecord] = {}
         self._proofs: Dict[str, EquivocationProof] = {}
         self._store = None  # evidence persistence hook (attach_store)

@@ -89,14 +89,20 @@ class StateManager:
 
     def wait_routines(self, timeout: float = 10.0) -> None:
         """Wait up to ``timeout`` total for live background routines
-        (reference: state/state.go:99-101)."""
-        import time
+        (reference: state/state.go:99-101).
 
-        deadline = time.monotonic() + timeout
+        Deliberately WALL time, not the node clock (audited for the
+        babblelint clock pass, docs/static_analysis.md): the routines
+        are real OS threads even under sim, and ``Thread.join`` blocks
+        in wall time — a virtual deadline would never advance while
+        joining and hang shutdown."""
+        from ..common.clock import WALL
+
+        deadline = WALL.monotonic() + timeout
         with self._routines_lock:
             routines = list(self._routines)
         for t in routines:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - WALL.monotonic()
             if remaining <= 0:
                 break
             t.join(timeout=remaining)

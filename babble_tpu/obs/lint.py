@@ -1,85 +1,24 @@
-"""Metrics-docs lint: the instrument catalog and the docs table must
-match exactly, in both directions.
+"""Metrics-docs lint — compat shim.
 
-Usage:  python -m babble_tpu.obs.lint [docs/observability.md]
-
-The docs file marks its instrument table with HTML comments::
-
-    <!-- metrics-table-start -->
-    | name | type | labels | scope | meaning |
-    ...
-    <!-- metrics-table-end -->
-
-Every first-column backticked name between the markers is compared to
-``obs.catalog.CATALOG``. A cataloged instrument missing from the table,
-or a documented name missing from the catalog, fails with exit code 1
-(wired into CI as ``make metricslint``).
+The metricslint implementation moved into the babblelint suite as its
+``metrics`` pass (``babble_tpu/analysis/metrics_pass.py``,
+docs/static_analysis.md); this module keeps the historical surface —
+``python -m babble_tpu.obs.lint [docs/observability.md]``, plus the
+``documented_names``/``run``/``main`` functions and the table markers —
+so ``make metricslint`` and existing imports keep working unchanged.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 
-from .catalog import CATALOG
-
-START = "<!-- metrics-table-start -->"
-END = "<!-- metrics-table-end -->"
-_ROW = re.compile(r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)`")
-
-
-def documented_names(text: str):
-    try:
-        body = text.split(START, 1)[1].split(END, 1)[0]
-    except IndexError:
-        raise SystemExit(
-            f"metrics lint: marker comments {START!r}/{END!r} not found "
-            "in the docs file"
-        )
-    names = set()
-    for line in body.splitlines():
-        m = _ROW.match(line.strip())
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def run(path: str) -> int:
-    with open(path, encoding="utf-8") as f:
-        docs = documented_names(f.read())
-    cataloged = {i.name for i in CATALOG}
-    missing_from_docs = sorted(cataloged - docs)
-    missing_from_catalog = sorted(docs - cataloged)
-    if missing_from_docs:
-        print(
-            "metrics lint: registered instruments missing from the docs "
-            f"table in {path}:",
-            file=sys.stderr,
-        )
-        for n in missing_from_docs:
-            print(f"  - {n}", file=sys.stderr)
-    if missing_from_catalog:
-        print(
-            "metrics lint: documented names missing from "
-            "babble_tpu/obs/catalog.py:",
-            file=sys.stderr,
-        )
-        for n in missing_from_catalog:
-            print(f"  - {n}", file=sys.stderr)
-    if missing_from_docs or missing_from_catalog:
-        return 1
-    print(
-        f"metrics lint ok: {len(cataloged)} instruments match "
-        f"between catalog and {path}"
-    )
-    return 0
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    path = argv[0] if argv else "docs/observability.md"
-    return run(path)
-
+from ..analysis.metrics_pass import (  # noqa: F401
+    END,
+    START,
+    documented_names,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

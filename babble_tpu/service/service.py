@@ -258,7 +258,7 @@ class Service:
         try:
             jax.profiler.start_trace(out_dir)
             try:
-                _time.sleep(seconds)
+                _time.sleep(seconds)  # lint: allow(clock: wall capture window for the live JAX device trace)
             finally:
                 jax.profiler.stop_trace()
         finally:

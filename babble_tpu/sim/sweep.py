@@ -238,6 +238,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "speedup_virtual": round(virtual_total / wall, 1) if wall else None,
         "seed": args.seed,
     }
+    # Runtime lock-order audit (docs/static_analysis.md §Lock model):
+    # with BABBLE_LOCKCHECK=1 the whole sweep doubles as an empirical
+    # check of the static lock graph — simsmoke asserts zero inversions.
+    from ..common import lockcheck
+
+    if lockcheck.ENABLED:
+        summary["lock_order_edges"] = len(lockcheck.RECORDER.edge_list())
+        summary["lock_inversions"] = len(lockcheck.RECORDER.inversions())
     line = json.dumps(summary, sort_keys=True)
     assert len(line) < 2000, "summary line contract: keep it compact"
     print(line)

@@ -56,8 +56,11 @@ mempoolsmoke:
 # shutdown/leave-under-partition checks; deterministic under
 # BABBLE_CHAOS_SEED (docs/robustness.md). The full nemesis storm
 # (flapper + slow peer, more rounds) stays behind -m slow.
+# BABBLE_LOCKCHECK=1 arms the runtime lock-order recorder
+# (common/lockcheck.py): the soak's real thread interleavings validate
+# the babblelint static lock graph — the soak asserts zero inversions.
 chaossmoke:
-	JAX_PLATFORMS=cpu BABBLE_CHAOS_SEED=42 python -m pytest tests/test_chaos.py -q -m "chaos and not slow"
+	JAX_PLATFORMS=cpu BABBLE_CHAOS_SEED=42 BABBLE_LOCKCHECK=1 python -m pytest tests/test_chaos.py -q -m "chaos and not slow"
 
 # chaossoak: the long storm, seed overridable for exploratory runs
 chaossoak:
@@ -85,9 +88,20 @@ obssmoke:
 	JAX_PLATFORMS=cpu python bench.py --obs --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['obs_ok'], d; assert d['commit_latency_samples'] > 0, d; assert not d['missing_metrics'], d; assert d['profile_stage_attributed'], d; oh=d.get('obs_overhead',{}); r=oh.get('ratio'); assert r is None or r >= 0.97, oh; po=d.get('profile_overhead',{}); cf=po.get('cpu_fraction'); assert cf is not None and cf < 0.02, po; assert po.get('samples_taken') is None or po['samples_taken'] > 0, po; print('obssmoke ok: clat p50', d['commit_latency_p50_ms'], 'ms, overhead ratio', r, 'profiler cpu_fraction', cf)"
 
 # metricslint: the instrument catalog and the docs table must match in
-# both directions (a new instrument cannot ship undocumented)
+# both directions (a new instrument cannot ship undocumented). Now a
+# thin shim over the babblelint metrics pass (docs/static_analysis.md).
 metricslint:
 	python -m babble_tpu.obs.lint docs/observability.md
+
+# staticcheck: babblelint, the project-wide static-analysis suite
+# (docs/static_analysis.md) — clock/RNG discipline, lock discipline,
+# knob drift, metrics drift, with self-linted inline allows. Then prove
+# its teeth the perfgate way: --self-proof injects one violation per
+# pass (plus a stale allow) and exits nonzero unless EVERY pass fires,
+# so a toothless linter fails the build, not the code it guards.
+staticcheck:
+	python -m babble_tpu.analysis
+	python -m babble_tpu.analysis --self-proof
 
 # perfgate: the perf observatory's CI teeth (docs/observability.md
 # §Perf ledger & regression gate) — backfill the pre-ledger artifacts
@@ -171,8 +185,10 @@ killtestnet:
 # Asserts zero violations, then proves the failure path end-to-end: an
 # injected failing invariant must shrink to a minimal reproducer
 # artifact that replays byte-identically.
+# BABBLE_LOCKCHECK=1: the sweep doubles as the sim-side lock-order
+# audit (docs/static_analysis.md §Lock model) — zero inversions asserted.
 simsmoke:
-	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --seeds 200 --out sim_artifacts | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['sim_scenarios'] >= 200, d; assert d['failed'] == 0, d; print('simsmoke ok:', d['sim_scenarios'], 'scenarios,', d['blocks_committed'], 'blocks,', str(d['speedup_virtual']) + 'x virtual speedup,', d['wall_s'], 's')"
+	JAX_PLATFORMS=cpu BABBLE_LOCKCHECK=1 python -m babble_tpu.sim.sweep --seeds 200 --out sim_artifacts | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['sim_scenarios'] >= 200, d; assert d['failed'] == 0, d; assert d.get('lock_inversions', 0) == 0, d; print('simsmoke ok:', d['sim_scenarios'], 'scenarios,', d['blocks_committed'], 'blocks,', str(d['speedup_virtual']) + 'x virtual speedup,', d['wall_s'], 's,', d.get('lock_order_edges', 0), 'lock edges, 0 inversions')"
 	rm -rf sim_artifacts_inject  # stale artifacts would break the ls-pick below after a generator change
 	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --seeds 1 --inject-failure --out sim_artifacts_inject | tail -n 1 | python -c "import json,sys,glob; d=json.loads(sys.stdin.read().strip()); assert d['failed'] == 1 and d['shrunk'] == 1 and d['artifacts'], d; print('shrink ok:', d['artifacts'][0])"
 	JAX_PLATFORMS=cpu python -m babble_tpu.sim.sweep --replay $$(ls sim_artifacts_inject/repro_*.json | head -n 1) | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['digests_match'] and d['violations'], d; print('replay ok: digests match')"
@@ -186,4 +202,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint staticcheck perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel

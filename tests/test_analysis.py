@@ -1,0 +1,538 @@
+"""babblelint — the static-analysis suite (docs/static_analysis.md).
+
+Fixture snippets per pass (violation caught / allow honored / stale
+allow rejected), the knob-drift contract against deliberately broken
+fixture config/cli pairs, the self-run (the real tree must be green),
+the self-proof (a toothless pass fails), and the runtime lock-order
+recorder — including the ISSUE-15 satellite: the observed edge set
+under a deterministic sim run validates the static model, surfaces in
+``get_stats``, and shows zero inversions.
+
+The clock fixes the pass forced are pinned by same-seed sim digest
+tests at the bottom (control-timer jitter stream, sentry proof stamps).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from babble_tpu.analysis import clock_pass, knob_pass, lock_pass
+from babble_tpu.analysis.core import (
+    SourceFile,
+    apply_allows,
+    load_tree,
+    repo_root,
+    run_passes,
+)
+
+# ---------------------------------------------------------------------------
+# clock pass
+
+
+def _clock(path: str, text: str):
+    files = [SourceFile.from_text(path, text)]
+    return apply_allows("clock", files, clock_pass.run(files, "."))
+
+
+def test_clock_flags_bare_time_and_global_random():
+    vs = _clock(
+        "babble_tpu/node/snippet.py",
+        "import time\nimport random\n\n"
+        "def f():\n"
+        "    time.sleep(1)\n"
+        "    return time.time() + random.random()\n",
+    )
+    msgs = " | ".join(v.message for v in vs)
+    assert len(vs) == 3
+    assert "time.sleep" in msgs and "time.time" in msgs
+    assert "random.random" in msgs
+
+
+def test_clock_flags_aliased_and_from_imports():
+    vs = _clock(
+        "babble_tpu/node/snippet.py",
+        "import time as _time\nfrom time import sleep\n\n"
+        "def f():\n    sleep(1)\n    return _time.monotonic()\n",
+    )
+    assert len(vs) == 2
+
+
+def test_clock_ignores_references_and_seeded_constructors():
+    vs = _clock(
+        "babble_tpu/node/snippet.py",
+        "import time\nimport random\n\n"
+        "def f(clock=time.monotonic, rng=None):\n"
+        "    rng = rng or random.Random(42)\n"
+        "    return clock(), rng.random()\n",
+    )
+    assert vs == []
+
+
+def test_clock_module_allowlist_skips_obs():
+    vs = _clock(
+        "babble_tpu/obs/snippet.py",
+        "import time\n\ndef stamp():\n    return time.time()\n",
+    )
+    assert vs == []
+
+
+def test_clock_allow_honored_same_line_and_line_above():
+    vs = _clock(
+        "babble_tpu/node/snippet.py",
+        "import time\n\n"
+        "def f():\n"
+        "    a = time.time()  # lint: allow(clock: wall stamp on purpose)\n"
+        "    # lint: allow(clock: and this one too)\n"
+        "    b = time.time()\n"
+        "    return a + b\n",
+    )
+    assert vs == []
+
+
+def test_stale_allow_is_rejected():
+    vs = _clock(
+        "babble_tpu/node/snippet.py",
+        "import os\n\n"
+        "# lint: allow(clock: nothing here violates)\n"
+        "x = os.getcwd()\n",
+    )
+    assert len(vs) == 1
+    assert "stale allow" in vs[0].message
+
+
+def test_unknown_pass_in_allow_is_an_error():
+    files = [
+        SourceFile.from_text(
+            "babble_tpu/node/snippet.py",
+            "# lint: allow(nonsense: what pass is this)\nx = 1\n",
+        )
+    ]
+    vs = run_passes(names=["clock"], files=files)
+    assert any("unknown pass 'nonsense'" in v.message for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# lock pass
+
+
+def _locks(text: str, path: str = "babble_tpu/node/snippet.py"):
+    files = [SourceFile.from_text(path, text)]
+    return apply_allows("locks", files, lock_pass.run(files, "."))
+
+
+def test_locks_flags_sleep_under_core_lock():
+    vs = _locks(
+        "import time\n\n"
+        "class Node:\n"
+        "    def gossip(self):\n"
+        "        with self.core_lock:\n"
+        "            time.sleep(0.1)\n"
+    )
+    assert len(vs) == 1
+    assert "blocking call under the core lock" in vs[0].message
+
+
+def test_locks_flags_transitive_blocking_via_self_call():
+    vs = _locks(
+        "import time\n\n"
+        "class Node:\n"
+        "    def slow(self):\n"
+        "        self.sock.sendall(b'x')\n"
+        "    def gossip(self):\n"
+        "        with self.core_lock:\n"
+        "            self.slow()\n"
+    )
+    assert any("reaches a blocking primitive" in v.message for v in vs)
+
+
+def test_locks_rpc_send_only_on_transport_receivers():
+    # Core.sync() is the LOCAL ingest — must not be flagged; the same
+    # name on self.trans is a network round-trip — must be flagged.
+    clean = _locks(
+        "class Node:\n"
+        "    def g(self):\n"
+        "        with self.core_lock:\n"
+        "            self.core.sync(events)\n"
+    )
+    assert clean == []
+    dirty = _locks(
+        "class Node:\n"
+        "    def g(self):\n"
+        "        with self.core_lock:\n"
+        "            self.trans.sync(peer, req)\n"
+    )
+    assert len(dirty) == 1 and "RPC send" in dirty[0].message
+
+
+def test_locks_detects_order_cycle():
+    # mempool->core directly in Mempool.a, core->mempool through the
+    # ATTR_TYPES-resolved call in Node.b — both snippets in ONE pass so
+    # the edges meet and close the cycle.
+    files = [
+        SourceFile.from_text(
+            "x/mempool/mempool.py",
+            "class Mempool:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            with self.core_lock:\n"
+            "                pass\n",
+        ),
+        SourceFile.from_text(
+            "babble_tpu/node/snippet.py",
+            "class Node:\n"
+            "    def b(self):\n"
+            "        with self.core_lock:\n"
+            "            self.mempool.a()\n",
+        ),
+    ]
+    vs = lock_pass.run(files, ".")
+    assert any("acquisition-order cycle" in v.message for v in vs), vs
+
+
+def test_locks_allow_honored():
+    vs = _locks(
+        "import time\n\n"
+        "class Node:\n"
+        "    def gossip(self):\n"
+        "        with self.core_lock:\n"
+        "            time.sleep(0.1)  # lint: allow(locks: measured, bounded, documented)\n"
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# knob pass (fixture config/cli/docs triple)
+
+
+def _knob_fixture(tmp_path, config_src: str, cli_src: str,
+                  docs_rows: str = ""):
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "design.md").write_text(
+        "<!-- knob-table-start -->\n| flag | field | meaning |\n"
+        "|---|---|---|\n" + docs_rows + "<!-- knob-table-end -->\n"
+    )
+    files = [
+        SourceFile.from_text(knob_pass.CONFIG_PATH, config_src),
+        SourceFile.from_text(knob_pass.CLI_PATH, cli_src),
+    ]
+    return apply_allows(
+        "knobs", files, knob_pass.run(files, str(tmp_path))
+    )
+
+
+_GOOD_CLI = """\
+_RUN_FLAGS = {
+    "heartbeat": ("heartbeat_timeout", float),
+}
+
+
+def build_parser():
+    run = sub.add_parser("run")
+    run.add_argument("--heartbeat", type=float, default=None)
+"""
+
+
+def test_knobs_green_fixture(tmp_path):
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n",
+        _GOOD_CLI,
+        "| `--heartbeat` | `heartbeat_timeout` | gossip cadence |\n",
+    )
+    assert vs == []
+
+
+def test_knobs_catches_orphaned_config_field(tmp_path):
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n"
+        "    ghost_knob: int = 7\n",
+        _GOOD_CLI,
+        "| `--heartbeat` | `heartbeat_timeout` | gossip cadence |\n",
+    )
+    assert len(vs) == 1 and "ghost_knob" in vs[0].message
+
+
+def test_knobs_allow_marks_runtime_injection_point(tmp_path):
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n"
+        "    # lint: allow(knobs: runtime injection point)\n"
+        "    clock: object = None\n",
+        _GOOD_CLI,
+        "| `--heartbeat` | `heartbeat_timeout` | gossip cadence |\n",
+    )
+    assert vs == []
+
+
+def test_knobs_catches_missing_argparse_dest(tmp_path):
+    # the --watchdog-interval drift class: _RUN_FLAGS entry, no flag
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n"
+        "    watchdog_interval_s: float = 1.0\n",
+        '_RUN_FLAGS = {\n'
+        '    "heartbeat": ("heartbeat_timeout", float),\n'
+        '    "watchdog_interval": ("watchdog_interval_s", float),\n'
+        '}\n\n\n'
+        'def build_parser():\n'
+        '    run = sub.add_parser("run")\n'
+        '    run.add_argument("--heartbeat", type=float, default=None)\n',
+        "| `--heartbeat` | `heartbeat_timeout` | gossip cadence |\n"
+        "| `watchdog_interval (toml)` | `watchdog_interval_s` | x |\n",
+    )
+    assert any(
+        "no run-subparser add_argument" in v.message for v in vs
+    ), vs
+
+
+def test_knobs_catches_dangling_flag_and_orphan_default(tmp_path):
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n"
+        "DEFAULT_UNUSED = 3\n\n\n"
+        "@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n",
+        '_RUN_FLAGS = {\n'
+        '    "heartbeat": ("heartbeat_timeout", float),\n'
+        '    "dangling": ("no_such_field", int),\n'
+        '}\n\n\n'
+        'def build_parser():\n'
+        '    run = sub.add_parser("run")\n'
+        '    run.add_argument("--heartbeat", type=float, default=None)\n'
+        '    run.add_argument("--dangling", type=int, default=None)\n',
+        "| `--heartbeat` | `heartbeat_timeout` | gossip cadence |\n"
+        "| `--dangling` | `no_such_field` | x |\n",
+    )
+    msgs = " | ".join(v.message for v in vs)
+    assert "does not exist" in msgs  # dangling _RUN_FLAGS attr
+    assert "orphaned constant DEFAULT_UNUSED" in msgs
+
+
+def test_knobs_docs_table_two_way(tmp_path):
+    vs = _knob_fixture(
+        tmp_path,
+        "from dataclasses import dataclass\n\n@dataclass\nclass Config:\n"
+        "    heartbeat_timeout: float = 0.01\n",
+        _GOOD_CLI,
+        "| `--fabricated-flag` | `nope` | not a real knob |\n",
+    )
+    msgs = " | ".join(v.message for v in vs)
+    assert "`--heartbeat` missing from the docs table" in msgs
+    assert "documented knob `--fabricated-flag` does not exist" in msgs
+
+
+# ---------------------------------------------------------------------------
+# the real tree must be green, and the self-proof must have teeth
+
+
+def test_self_run_tree_is_green():
+    vs = run_passes()
+    assert vs == [], "babblelint violations on the tree:\n" + "\n".join(
+        v.render() for v in vs
+    )
+
+
+def test_self_proof_all_passes_fire():
+    from babble_tpu.analysis.__main__ import self_proof
+
+    assert self_proof() == 0
+
+
+def test_cli_entrypoint_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\ndef f():\n    return time.time()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "babble_tpu.analysis", "--pass", "clock",
+         str(bad)],
+        cwd=repo_root(), capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "bare time.time()" in proc.stderr
+
+
+def test_obs_lint_shim_still_works():
+    from babble_tpu.obs import lint as shim
+
+    assert shim.run(os.path.join(repo_root(),
+                                 "docs/observability.md")) == 0
+    assert shim.documented_names(
+        "<!-- metrics-table-start -->\n| `x_total` | c |\n"
+        "<!-- metrics-table-end -->"
+    ) == {"x_total"}
+
+
+def test_static_edges_include_core_mempool():
+    """The static lock graph must keep seeing the one legitimate edge
+    (core -> mempool: drain/requeue/mark_committed under the core
+    lock). If this breaks, either the lock moved (update the model) or
+    the pass regressed."""
+    files = load_tree()
+    assert "core->mempool" in lock_pass.static_edges(files)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order recorder (BABBLE_LOCKCHECK)
+
+
+def test_lockcheck_recorder_edges_and_inversions():
+    from babble_tpu.common import lockcheck
+    from babble_tpu.common.timed_lock import TimedLock
+
+    rec = lockcheck.LockOrderRecorder()
+    old = lockcheck.RECORDER
+    lockcheck.RECORDER = rec
+    lockcheck.set_enabled(True)
+    try:
+        a, b = TimedLock(name="a"), TimedLock(name="b")
+        with a:
+            with b:
+                pass
+        assert rec.edge_list() == ["a->b"]
+        assert rec.inversions() == []
+        with b:
+            with a:
+                pass
+        assert rec.edge_list() == ["a->b", "b->a"]
+        assert len(rec.inversions()) == 1
+        assert "a<->b" in rec.inversions()[0]
+    finally:
+        lockcheck.set_enabled(False)
+        lockcheck.RECORDER = old
+
+
+def test_lockcheck_disabled_records_nothing():
+    from babble_tpu.common import lockcheck
+    from babble_tpu.common.timed_lock import TimedLock
+
+    rec = lockcheck.LockOrderRecorder()
+    old = lockcheck.RECORDER
+    lockcheck.RECORDER = rec
+    try:
+        a, b = TimedLock(name="a"), TimedLock(name="b")
+        with a:
+            with b:
+                pass
+        assert rec.edge_list() == []
+    finally:
+        lockcheck.RECORDER = old
+
+
+@pytest.mark.sim
+def test_lockcheck_sim_run_validates_static_model_and_get_stats():
+    """ISSUE-15 satellite: a deterministic sim run with the recorder
+    armed observes the static model's core->mempool edge, zero
+    inversions, and surfaces both through get_stats."""
+    from babble_tpu.common import lockcheck
+    from babble_tpu.crypto.keys import set_deterministic_signing
+    from babble_tpu.sim.harness import SimCluster
+    from babble_tpu.sim.scheduler import SimScheduler
+
+    rec = lockcheck.LockOrderRecorder()
+    old = lockcheck.RECORDER
+    lockcheck.RECORDER = rec
+    lockcheck.set_enabled(True)
+    prev = set_deterministic_signing(True)
+    cluster = None
+    try:
+        sch = SimScheduler(1234)
+        cluster = SimCluster(sch, 3, heartbeat_s=0.05)
+        cluster.start()
+        txrng = sch.rng("txmix")
+        for k in range(8):
+            sch.at(0.05 + 0.05 * k,
+                   lambda: cluster.submit_auto(txrng), "tx")
+        sch.run_until(3.0)
+        edges = rec.edge_list()
+        assert "core->mempool" in edges, edges
+        assert rec.inversions() == []
+        snap = cluster.nodes[0].get_stats_snapshot()
+        assert snap["lock_order_edges"] == edges
+        assert snap["lock_order_inversions"] == 0
+        # the stringly compat view carries them too
+        assert "lock_order_edges" in cluster.nodes[0].get_stats()
+    finally:
+        lockcheck.set_enabled(False)
+        lockcheck.RECORDER = old
+        set_deterministic_signing(prev)
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the clock fixes, pinned by same-seed digests (ISSUE-15 satellite)
+
+
+def test_control_timer_jitter_stream_is_seeded():
+    import random
+
+    from babble_tpu.node.control_timer import ControlTimer
+
+    t1 = ControlTimer(rng=random.Random("seed|control_timer|1"))
+    t2 = ControlTimer(rng=random.Random("seed|control_timer|1"))
+    seq1 = [t1._jitter(0.05) for _ in range(16)]
+    seq2 = [t2._jitter(0.05) for _ in range(16)]
+    assert seq1 == seq2
+    assert all(0.05 <= w < 0.10 for w in seq1)
+    t3 = ControlTimer(rng=random.Random("seed|control_timer|2"))
+    assert [t3._jitter(0.05) for _ in range(16)] != seq1
+
+
+def _byz_sim_run(seed: int):
+    """One equivocation sim run → (commit digests, sentry-proof digest).
+    The proof digest covers observed_at: before the sentry fix those
+    stamps were bare wall time and differed between same-seed runs."""
+    from babble_tpu.crypto.keys import set_deterministic_signing
+    from babble_tpu.sim.harness import SimCluster
+    from babble_tpu.sim.scheduler import SimScheduler
+
+    prev = set_deterministic_signing(True)
+    cluster = None
+    try:
+        sch = SimScheduler(seed)
+        cluster = SimCluster(sch, 4, n_byzantine=1, attack="equivocate",
+                             heartbeat_s=0.05)
+        cluster.start()
+        txrng = sch.rng("txmix")
+        for k in range(10):
+            sch.at(0.05 + 0.06 * k,
+                   lambda: cluster.submit_auto(txrng), "tx")
+        sch.run_until(4.0)
+        proofs = sorted(
+            json.dumps(p.to_dict(), sort_keys=True)
+            for n in cluster.nodes
+            for p in n.core.sentry.proofs()
+        )
+        proof_digest = hashlib.sha256(
+            "\n".join(proofs).encode()
+        ).hexdigest()
+        return cluster.commit_digests(), proof_digest, len(proofs)
+    finally:
+        set_deterministic_signing(prev)
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+
+
+@pytest.mark.sim
+def test_same_seed_sentry_proof_digests_byte_identical():
+    c1, p1, n1 = _byz_sim_run(777)
+    c2, p2, n2 = _byz_sim_run(777)
+    assert n1 >= 1, "equivocation scenario must mint at least one proof"
+    assert (c1, p1, n1) == (c2, p2, n2)

@@ -175,14 +175,15 @@ def _shutdown(nodes, byzs):
 # -- the capstone soak ----------------------------------------------------
 
 
-@pytest.mark.byz
-def test_equivocation_soak_quarantine_proofs_and_restart(tmp_path):
-    """Acceptance (ISSUE-5): 4 honest + 1 equivocating node under 10%
+def _equivocation_soak_attempt(tmp_path):
+    """One full equivocation-soak attempt (see the test below for the
+    acceptance contract): 4 honest + 1 equivocating node under 10%
     chaos drop on the adversary's links. Honest nodes commit identical
     chains past the attack window; the adversary lands in quarantine with
     a verifiable equivocation proof on honest nodes; the proof survives a
     restart of the persistent node with --store --bootstrap; queues stay
     bounded."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
     network, peers, keys, nodes, proxies, byzs = make_mixed_cluster(
         4, "equivocate", tmp_path=tmp_path, chaos_drop=0.10,
         byz_kwargs={"fork_height": 1, "interval": 0.03},
@@ -289,6 +290,25 @@ def test_equivocation_soak_quarantine_proofs_and_restart(tmp_path):
         assert EquivocationProof.from_dict(body2["proofs"][0]).verify()
     finally:
         _shutdown(nodes, byzs)
+
+
+@pytest.mark.byz
+def test_equivocation_soak_quarantine_proofs_and_restart(tmp_path):
+    """Acceptance (ISSUE-5) — with the ISSUE-15 retry-once corroboration:
+    this soak is the known under-load tier-1 flake (it passes standalone;
+    a loaded host can starve the 4-node cluster past the drive window).
+    Same pattern as gossipsmoke's A/B re-run: a first-attempt assertion
+    failure triggers ONE full fresh-cluster re-run, and only a failure of
+    BOTH runs fails the test — corroboration, not masking: a real
+    regression fails twice, a host-load artifact doesn't repeat."""
+    try:
+        _equivocation_soak_attempt(tmp_path / "run1")
+    except AssertionError as first:
+        print(
+            "byz soak: first attempt failed under load "
+            f"({str(first)[:200]}); corroborating with one re-run"
+        )
+        _equivocation_soak_attempt(tmp_path / "run2")
 
 
 # -- receiving-side caps under a real oversize attacker -------------------

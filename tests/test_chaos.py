@@ -390,6 +390,24 @@ def test_chaos_soak_partition_heal_converges():
                 "sync_stage_seconds", stage="request_sync"
             )
             assert hs is not None and hs["count"] > 0
+        # Runtime lock-order audit (docs/static_analysis.md §Lock
+        # model): with BABBLE_LOCKCHECK=1 (the chaossmoke CI leg) the
+        # soak's real thread interleavings must produce ZERO
+        # acquisition-order inversions, and the observed edges surface
+        # through get_stats.
+        from babble_tpu.common import lockcheck
+
+        if lockcheck.ENABLED:
+            inv = lockcheck.RECORDER.inversions()
+            assert not inv, f"lock-order inversions under chaos: {inv}"
+            # edge set is monotone and gossip threads are still live, so
+            # read-then-snapshot and assert containment (an equality
+            # check would race a first-occurrence edge landing between
+            # the two reads)
+            edges = lockcheck.RECORDER.edge_list()
+            snap = nodes[0].get_stats_snapshot()
+            assert set(edges) <= set(snap["lock_order_edges"])
+            assert snap["lock_order_inversions"] == 0
     finally:
         _shutdown_all(nodes)
 
