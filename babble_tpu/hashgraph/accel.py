@@ -198,7 +198,8 @@ class TensorConsensus:
                  batcher: bool | None = None,
                  resident: bool | None = None,
                  breaker: CircuitBreaker | None = None,
-                 clock=None):
+                 clock=None,
+                 owner: str | None = None):
         # Force a sweep mid-batch once this many inserts accumulate, so the
         # window tensors stay inside one shape bucket even under huge syncs.
         # Normal cadence is one sweep per gossip round (core.sync flush).
@@ -225,21 +226,31 @@ class TensorConsensus:
         # the device mesh (parallel/voting_shard.py) instead of on one
         # device. Output is bit-identical; only placement differs.
         self.mesh = mesh
+        # Validator identity for the coprocessor stats (the SweepBatcher
+        # counts distinct owners multiplexed onto one mesh); falls back to
+        # a per-engine token when the node doesn't name itself.
+        self.owner = owner
         # Co-located batching: route sweeps through the process-wide
         # SweepBatcher so all nodes on this host share ONE device dispatch
         # per flush wave (BASELINE config-3 architecture). None = resolve
-        # from BABBLE_ACCEL_BATCH at first flush. Mutually exclusive with
-        # mesh sharding (the batcher dispatches single-device programs).
+        # from BABBLE_ACCEL_BATCH at first flush. With a mesh the batcher
+        # runs as a consensus coprocessor: co-located validators' windows
+        # are padded to one aligned bucket and multiplexed onto the SAME
+        # sharded program (shared per-mesh compile cache, one wave of
+        # overlapped dispatches).
         self.batcher = batcher
         # Incremental device-resident windows (ops/window_state.py): the
         # snapshot is a persistent WindowState updated in O(ΔE) per sweep,
         # and the window tensors stay on the device between sweeps (the
         # resident program donates the previous buffers and applies a
         # compact delta). None = resolve from BABBLE_ACCEL_RESIDENT at
-        # first flush (default ON); forced off under mesh sharding (the
-        # sharded program owns its placement). With the batcher, the host
-        # side stays incremental but windows are submitted as copies (the
-        # vmapped batch program cannot donate per-node buffers).
+        # first flush (default ON). Under a mesh, residency is per-shard:
+        # the delta scatters into the sharded buffers through the mesh
+        # resident program (voting_shard.resident_jitted) and the
+        # single-device rebuild stays the correctness oracle. With the
+        # batcher, the host side stays incremental but windows are
+        # submitted as copies (the batch wave cannot donate per-node
+        # buffers).
         self.resident = resident
         self.window_state = None
         # Device-path circuit breaker: transient failures fall back to the
@@ -260,6 +271,12 @@ class TensorConsensus:
         self.stale_drops = 0  # readbacks discarded by the generation check
         self.rows_delta_total = 0  # delta rows uploaded across sweeps
         self.rows_reused_total = 0  # resident rows reused across sweeps
+        # Mesh padding visibility (satellite: no more silent single-device
+        # fallback when W doesn't divide the mesh): rows added to align
+        # the witness axis, and windows that still dropped to the
+        # single-device program because padding itself failed.
+        self.mesh_pad_rows = 0
+        self.mesh_fallbacks = 0
         self.generation = 0  # bumped by Hashgraph.reset/bootstrap
         # A sweep whose readback exceeds this is abandoned (tunnel wedge):
         # the oracle takes over so a dead device can stall only one sweep's
@@ -344,12 +361,51 @@ class TensorConsensus:
     # -- compile management -------------------------------------------------
 
     def _use_mesh(self, win) -> bool:
-        """True when _dispatch will take the sharded path for this window
-        (a mesh is configured AND the witness axis divides it)."""
+        """True when _dispatch will take the sharded path for this window.
+        With a mesh configured this is the normal case: windows whose
+        witness axis the mesh size doesn't divide are PADDED to it by
+        _mesh_align before they get here — the old silent single-device
+        fallback is gone. A window that still arrives unaligned (padding
+        failed; counted in mesh_fallbacks) rides the single program."""
         return (
             self.mesh is not None
             and win.n_witnesses % self.mesh.devices.size == 0
         )
+
+    def _mesh_align(self, win):
+        """Pad the witness axis so the mesh size divides it (repad_window:
+        neutral fills, real rows keep their indexes, decisions identical).
+        Counts the padding in mesh_pad_rows; a padding failure counts a
+        mesh_fallback and returns the window unchanged (single-device)."""
+        n = int(self.mesh.devices.size)
+        if n <= 0 or win.n_witnesses % n == 0:
+            return win
+        from babble_tpu.ops import voting
+
+        key = voting.bucket_key(win)
+        W_m = key[0]
+        while W_m % n:
+            if W_m > key[0] * n:
+                # doubling a power-of-two W can never reach a multiple of
+                # a mesh with an odd factor — give up, ride single-device
+                self.mesh_fallbacks += 1
+                return win
+            W_m *= 2
+        try:
+            padded = voting.repad_window(win, (W_m,) + key[1:])
+        except Exception:
+            logger.warning(
+                "mesh witness-axis padding failed for bucket %s", key,
+                exc_info=True,
+            )
+            self.mesh_fallbacks += 1
+            return win
+        self.mesh_pad_rows += W_m - key[0]
+        return padded
+
+    def _copro_owner(self) -> str:
+        """Stable validator identity for coprocessor multiplexing stats."""
+        return self.owner if self.owner else f"tc-{id(self):x}"
 
     def _bucket_ready(self, win) -> bool:
         """True when the window's shape bucket is compiled FOR THE PATH
@@ -454,26 +510,23 @@ class TensorConsensus:
             # run at full host throughput (measured: 16-node threaded
             # accel dropped ~2.7x with the batcher forced on), so CPU
             # tests that force pipeline=True must not pick it up.
-            # BABBLE_ACCEL_BATCH=1/0 overrides either way; mesh-sharded
-            # dispatch and the batcher are mutually exclusive (the batcher
-            # stacks single-device programs).
+            # BABBLE_ACCEL_BATCH=1/0 overrides either way. With a mesh
+            # the batcher multiplexes co-located validators onto the
+            # sharded program (the coprocessor mode) instead of stacking
+            # single-device ones.
             env = os.environ.get("BABBLE_ACCEL_BATCH")
             if env is not None:
-                self.batcher = env == "1" and self.mesh is None
+                self.batcher = env == "1"
             else:
                 from babble_tpu.ops.device import on_accelerator
 
-                self.batcher = on_accelerator() and self.mesh is None
+                self.batcher = on_accelerator()
         if self.resident is None:
             self.resident = resident_default_on()
-        if self.mesh is not None:
-            # the sharded program owns its own placement; residency and
-            # donation are single-device disciplines
-            self.resident = False
         if self.resident and self.window_state is None:
             from babble_tpu.ops.window_state import WindowState
 
-            self.window_state = WindowState()
+            self.window_state = WindowState(mesh=self.mesh)
         # turn on the hashgraph's delta channels (new witnesses, fd
         # mutations) exactly when a WindowState consumes them
         hg._accel_track_delta = bool(self.resident)
@@ -540,8 +593,9 @@ class TensorConsensus:
     def _dispatch(self, win):
         """Launch the fused sweep — single-device, or witness-axis sharded
         over the configured mesh (bit-identical output, different
-        placement). Mesh buckets whose W the mesh size doesn't divide fall
-        back to single-device placement."""
+        placement). Windows reach here already mesh-aligned (_mesh_align);
+        one that didn't (padding failed) is counted and rides the
+        single-device program."""
         from babble_tpu.ops import voting
 
         if self._use_mesh(win):
@@ -550,6 +604,8 @@ class TensorConsensus:
             return voting_shard._jitted(self.mesh)(
                 *voting_shard.place_window(self.mesh, win)
             )
+        if self.mesh is not None:
+            self.mesh_fallbacks += 1
         return voting.launch_sweep(win)
 
     def _snapshot(self, hg, for_batcher: bool = False):
@@ -586,17 +642,25 @@ class TensorConsensus:
         stays device-resident: the delta program (once warm) donates the
         previous buffers and uploads only the delta; until it is warm the
         full-upload path reseeds residency through the plain program while
-        a background thread compiles the delta program."""
-        if snap is None or self.batcher or self._use_mesh(win):
+        a background thread compiles the delta program. Under a mesh the
+        same discipline runs sharded: the delta scatters into per-shard
+        resident buffers via voting_shard.resident_jitted."""
+        if snap is None or self.batcher:
             return self._dispatch(win)
         from babble_tpu.ops import window_state as ws
 
         state = self.window_state
+        if state.mesh is not None:
+            from babble_tpu.parallel import voting_shard
+
+            ready = voting_shard.resident_bucket_ready(state.mesh, state.key)
+        else:
+            ready = ws.resident_ready(state.key)
         if (
             snap.delta is not None
             and state.device is not None
             and self.async_compile
-            and not ws.resident_ready(state.key)
+            and not ready
         ):
             self._kick_resident(state.key)
         out, _used_delta = state.dispatch(
@@ -607,7 +671,8 @@ class TensorConsensus:
     def _kick_resident(self, key: tuple) -> None:
         from babble_tpu.ops import window_state as ws
 
-        gate = (key, "resident")
+        mesh = self.window_state.mesh if self.window_state else None
+        gate = (key, "resident", mesh is not None)
         with self._lock:
             if gate in self._compiling:
                 return
@@ -616,10 +681,16 @@ class TensorConsensus:
         def work() -> None:
             try:
                 t0 = time.perf_counter()
-                ws.precompile_resident(*key)
+                if mesh is not None:
+                    from babble_tpu.parallel import voting_shard
+
+                    voting_shard.precompile_resident(mesh, *key)
+                else:
+                    ws.precompile_resident(*key)
                 logger.info(
-                    "resident delta program ready for bucket %s in %.1fs",
-                    key, time.perf_counter() - t0,
+                    "resident delta program ready for bucket %s (mesh=%s)"
+                    " in %.1fs",
+                    key, mesh is not None, time.perf_counter() - t0,
                 )
             except Exception:
                 logger.warning(
@@ -640,6 +711,10 @@ class TensorConsensus:
             if win is None:
                 self.breaker.cancel()  # no device attempt to judge
                 return True  # nothing undecided
+            if self.mesh is not None:
+                # resident snapshots are already mesh-aligned (WindowState
+                # aligns W at rebuild); this pads the legacy/batcher path
+                win = self._mesh_align(win)
             if not self._bucket_ready(win):
                 if snap is not None:
                     # the snapshot's delta is committed to the mirrors but
@@ -657,7 +732,9 @@ class TensorConsensus:
             # its own backpressure replaces the admission slots.
             from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
 
-            ticket = SweepBatcher.instance().submit(win)
+            ticket = SweepBatcher.instance().submit(
+                win, mesh=self.mesh, owner=self._copro_owner()
+            )
             if ticket is None:
                 # backlogged: the oracle carries this flush (same
                 # economics as losing an admission slot)
@@ -802,6 +879,8 @@ class TensorConsensus:
             if win is None:
                 self.breaker.cancel()  # no device attempt to judge
                 return True  # nothing undecided
+            if self.mesh is not None:
+                win = self._mesh_align(win)
             if not self._bucket_ready(win):
                 self.breaker.cancel()
                 return False
@@ -812,7 +891,9 @@ class TensorConsensus:
                 # same wave share the dispatch.
                 from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
 
-                ticket = SweepBatcher.instance().submit(win)
+                ticket = SweepBatcher.instance().submit(
+                    win, mesh=self.mesh, owner=self._copro_owner()
+                )
                 if ticket is None:
                     self.contended += 1
                     self.breaker.cancel()
@@ -930,6 +1011,10 @@ class TensorConsensus:
                 else 0
             ),
             "accel_stale_drops": self.stale_drops,
+            # Mesh padding visibility: witness rows added to align W to
+            # the mesh, and windows that dropped to single-device anyway
+            "accel_mesh_pad_rows": self.mesh_pad_rows,
+            "accel_mesh_fallbacks": self.mesh_fallbacks,
         }
         # circuit-breaker surface: accel_breaker_state/open/probes/skips/
         # failures (open = count of closed→open transitions)
@@ -1022,15 +1107,19 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
                         exc_info=True,
                     )
         for key in buckets:
-            mesh_covers = (
-                mesh is not None and key[0] % mesh.devices.size == 0
-            )
-            if mesh_covers:
+            if mesh is not None:
                 # the sharded kernel is the only one _dispatch will ever
                 # run for this bucket — don't burn compile time (and
-                # device contention) on the unused single-device program
+                # device contention) on the unused single-device program.
+                # Buckets whose W the mesh doesn't divide are warmed at
+                # the shape _mesh_align pads them to.
                 from babble_tpu.parallel import voting_shard
 
+                n = int(mesh.devices.size)
+                W_m = key[0]
+                while W_m % n:
+                    W_m *= 2
+                key = (W_m,) + key[1:]
                 if not voting_shard.bucket_ready(mesh, key):
                     try:
                         voting_shard.precompile(mesh, *key)
@@ -1038,6 +1127,17 @@ def prewarm_buckets(n_peers: int, background: bool = True, mesh=None):
                         logger.warning(
                             "mesh prewarm failed for %s", key, exc_info=True
                         )
+                if resident_default_on() and not batcher_default_on():
+                    # the mesh resident delta program is a separate
+                    # executable, same rationale as the single-device one
+                    if not voting_shard.resident_bucket_ready(mesh, key):
+                        try:
+                            voting_shard.precompile_resident(mesh, *key)
+                        except Exception:
+                            logger.warning(
+                                "mesh resident prewarm failed for %s", key,
+                                exc_info=True,
+                            )
             elif not voting.bucket_ready(key):
                 try:
                     voting.precompile(*key)

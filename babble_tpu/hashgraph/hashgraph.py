@@ -23,6 +23,8 @@ import logging
 import os
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from babble_tpu.common.errors import StoreError, StoreErrorKind, is_store_err
 from babble_tpu.common.lru import LRU
 from babble_tpu.common.utils import median_int
@@ -71,6 +73,39 @@ CommitCallback = Callable[[Block], None]
 
 def dummy_commit_callback(block: Block) -> None:
     """reference: hashgraph.go:1687-1689."""
+
+
+# Strongly-see sentinel coordinates: a missing last-ancestor /
+# first-descendant entry must never satisfy ``la >= fd``, whatever the
+# real (non-negative) indexes are.
+_LA_MISSING = -(2**62)
+_FD_MISSING = 2**62
+
+
+class _RoundCtx:
+    """Per-round data resolved ONCE and reused across the whole ingest
+    batch: the round's peer-set columns, super-majority, witness list, and
+    the witnesses' first-descendant coordinates as one dense matrix. This
+    turns the per-event ``strongly_see`` loop in ``_round`` (and the
+    per-voter loop in DecideFame's oracle) into a single vectorized
+    compare — the dict-walk version is the profiled host-tail hotspot.
+
+    Invalidation: a witness added to the round (divide_rounds /
+    insert_frame_event) or a cached witness's first_descendants mutating
+    (the insert-time walk) drops the entry; a peer-set object swap or a
+    created-event count change is caught at lookup time."""
+
+    __slots__ = ("peer_set", "sm", "col", "wits", "wit_set", "fd",
+                 "n_created")
+
+    def __init__(self, peer_set, wits, fd, n_created):
+        self.peer_set = peer_set
+        self.sm = peer_set.super_majority()
+        self.col = {pk: i for i, pk in enumerate(peer_set.pub_keys())}
+        self.wits = wits
+        self.wit_set = frozenset(wits)
+        self.fd = fd  # int64 [n_wit, n_peers], missing = _FD_MISSING
+        self.n_created = n_created
 
 
 def middle_bit(ehex: str) -> bool:
@@ -143,6 +178,12 @@ class Hashgraph:
         self._round_cache = LRU(cs)
         self._timestamp_cache = LRU(cs)
         self._witness_cache = LRU(cs)
+        # round -> _RoundCtx, consulted by _round/_witness on every insert.
+        # Entries self-validate against the round's created-event count and
+        # peer-set identity; the only mutation that check cannot catch — a
+        # cached witness gaining a first-descendant entry — is invalidated
+        # explicitly in _update_ancestor_first_descendant.
+        self._round_ctx: Dict[int, _RoundCtx] = {}
 
     def init(self, peer_set: PeerSet) -> None:
         """Set the genesis peer-set at round 0 (reference: hashgraph.go:84-89).
@@ -222,6 +263,60 @@ class Hashgraph:
                 c += 1
         return c >= peers.super_majority()
 
+    def _build_round_ctx(self, peer_set, wits, n_created) -> _RoundCtx:
+        """Densify the witnesses' first-descendant coordinates into one
+        int64 matrix so strongly-see against ALL of a round's witnesses is
+        a single vectorized compare (the exact computation the device
+        voting window performs on its fd/la tables — see ops/voting)."""
+        fd = np.full(
+            (len(wits), len(peer_set.pub_keys())), _FD_MISSING, dtype=np.int64
+        )
+        col = {pk: i for i, pk in enumerate(peer_set.pub_keys())}
+        for i, w in enumerate(wits):
+            for p, e in self.store.get_event(w).first_descendants.items():
+                j = col.get(p)
+                if j is not None:
+                    fd[i, j] = e.index
+        return _RoundCtx(peer_set, wits, fd, n_created)
+
+    def _round_ctx_for(self, r: int, round_info, peer_set) -> _RoundCtx:
+        """Cached per-round ctx, revalidated cheaply on every lookup: a
+        created-event count change forces a witness-list recompute, and a
+        changed witness list (or peer-set swap) forces a matrix rebuild.
+        When only non-witness events were added, the ctx survives with its
+        count refreshed — the common case on the hot insert path."""
+        ctx = self._round_ctx.get(r)
+        n_created = len(round_info.created_events)
+        if ctx is not None and ctx.peer_set is peer_set:
+            if ctx.n_created == n_created:
+                return ctx
+            wits = round_info.witnesses()
+            if ctx.wits == wits:
+                ctx.n_created = n_created
+                return ctx
+        else:
+            wits = round_info.witnesses()
+        ctx = self._build_round_ctx(peer_set, wits, n_created)
+        if len(self._round_ctx) >= 128:
+            # Consensus advances monotonically; old rounds stop being
+            # parent rounds, so prune from the bottom.
+            for k in sorted(self._round_ctx)[:64]:
+                del self._round_ctx[k]
+        self._round_ctx[r] = ctx
+        return ctx
+
+    def _strongly_seen_mask(self, x: str, ctx: _RoundCtx):
+        """Boolean mask over ctx.wits: which witnesses x strongly sees.
+        Missing-coordinate sentinels guarantee ``la >= fd`` is False when
+        either side is absent, for any real (non-negative) index."""
+        ex = self.store.get_event(x)
+        la = np.full((len(ctx.col),), _LA_MISSING, dtype=np.int64)
+        for p, e in ex.last_ancestors.items():
+            j = ctx.col.get(p)
+            if j is not None:
+                la[j] = e.index
+        return (la[None, :] >= ctx.fd).sum(axis=1) >= ctx.sm
+
     # =========================================================================
     # Round / witness / timestamps
     # =========================================================================
@@ -258,10 +353,13 @@ class Hashgraph:
         parent_round_obj = self.store.get_round(parent_round)
         parent_round_peer_set = self.store.get_peer_set(parent_round)
 
-        c = 0
-        for w in parent_round_obj.witnesses():
-            if self.strongly_see(x, w, parent_round_peer_set):
-                c += 1
+        # One vectorized compare against the round's witness fd matrix
+        # replaces the per-witness strongly_see loop — the profiled host
+        # tail of divide_rounds (thousands of dict walks per ingest batch).
+        ctx = self._round_ctx_for(
+            parent_round, parent_round_obj, parent_round_peer_set
+        )
+        c = int(self._strongly_seen_mask(x, ctx).sum()) if ctx.wits else 0
         if c >= parent_round_peer_set.super_majority():
             round_ += 1
         return round_
@@ -425,6 +523,12 @@ class Hashgraph:
                     self.store.set_event(a)
                     if self._accel_track_delta:
                         self._accel_fd_dirty.add(ah)
+                    # A cached round-ctx matrix snapshots witness fds; this
+                    # is the one mutation its count check cannot see.
+                    if a.round is not None:
+                        ctx = self._round_ctx.get(a.round)
+                        if ctx is not None and ah in ctx.wit_set:
+                            del self._round_ctx[a.round]
                     # Stop at witnesses so the walk doesn't descend to the
                     # bottom of the graph (reference: hashgraph.go:503-512).
                     try:
@@ -600,21 +704,36 @@ class Hashgraph:
         events can never need reassignment, so re-fetching the full
         undetermined backlog per pass (the reference's loop shape) would be
         pure store/LRU overhead. On error the unprocessed suffix is
-        requeued so the next pass retries it."""
+        requeued so the next pass retries it.
+
+        set_round writes are coalesced per TOUCHED ROUND rather than issued
+        per event: a fresh round still registers immediately (get_round /
+        last_round must see it mid-batch), but the per-event re-writes of an
+        already-registered round collapse into one flush per round at the
+        end of the pass — on the persistent store that turns O(batch) SQL
+        upserts into O(distinct rounds). The flush runs in a finally so a
+        mid-batch error still persists every mutation already applied to
+        the (shared, mutable) RoundInfo objects."""
         pending = self._round_pending
         if not pending:
             return
         self._round_pending = []
         done = 0
+        touched: Dict[int, RoundInfo] = {}
         try:
             for hash_ in pending:
-                self._assign_round_and_lamport(hash_)
+                self._assign_round_and_lamport(hash_, touched)
                 done += 1
         except BaseException:
             self._round_pending = pending[done:] + self._round_pending
             raise
+        finally:
+            for r, ri in touched.items():
+                self.store.set_round(r, ri)
 
-    def _assign_round_and_lamport(self, hash_: str) -> None:
+    def _assign_round_and_lamport(
+        self, hash_: str, round_infos: Optional[Dict[int, "RoundInfo"]] = None
+    ) -> None:
         ev = self.store.get_event(hash_)
         update_event = False
 
@@ -624,12 +743,18 @@ class Hashgraph:
             # so mutating first would make the requeued retry see
             # "round already assigned" and skip witness registration forever.
             round_number = self.round(hash_)
-            try:
-                round_info = self.store.get_round(round_number)
-            except StoreError as err:
-                if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
-                    raise
-                round_info = RoundInfo()
+            round_info = (
+                None if round_infos is None else round_infos.get(round_number)
+            )
+            fresh_round = False
+            if round_info is None:
+                try:
+                    round_info = self.store.get_round(round_number)
+                except StoreError as err:
+                    if not is_store_err(err, StoreErrorKind.KEY_NOT_FOUND):
+                        raise
+                    round_info = RoundInfo()
+                    fresh_round = True
             is_witness = self.witness(hash_)
             ev.set_round(round_number)
             update_event = True
@@ -645,7 +770,13 @@ class Hashgraph:
                 self.pending_rounds.set(PendingRound(round_number, False))
 
             round_info.add_created_event(hash_, is_witness)
-            self.store.set_round(round_number, round_info)
+            if round_infos is None or fresh_round:
+                # A fresh round registers immediately — the very next event
+                # in the batch may read it via get_round / last_round.
+                # Known rounds defer to divide_rounds' per-round flush.
+                self.store.set_round(round_number, round_info)
+            if round_infos is not None:
+                round_infos[round_number] = round_info
             if is_witness and self._accel_track_delta:
                 self._accel_new_witnesses.append((round_number, hash_))
 
@@ -685,13 +816,22 @@ class Hashgraph:
             return e
 
         ss_memo: Dict[tuple, list] = {}  # (y, j_prev) -> strongly-seen list
+        ctx_memo: Dict[int, _RoundCtx] = {}  # j_prev -> fd-matrix ctx
 
         def ss_witnesses_of(y: str, j_prev: int) -> list:
             k = (y, j_prev)
             v = ss_memo.get(k)
             if v is None:
                 prev_ps, prev_wits = round_data(j_prev)
-                v = [w for w in prev_wits if self.strongly_see(y, w, prev_ps)]
+                # Built from the per-pass captured witness list (NOT the
+                # cross-pass _round_ctx), so the voter mask sees exactly
+                # the snapshot round_data froze for this stage.
+                ctx = ctx_memo.get(j_prev)
+                if ctx is None:
+                    ctx = self._build_round_ctx(prev_ps, prev_wits, 0)
+                    ctx_memo[j_prev] = ctx
+                mask = self._strongly_seen_mask(y, ctx)
+                v = [w for w, s in zip(prev_wits, mask) if s]
                 ss_memo[k] = v
             return v
 
@@ -1049,6 +1189,7 @@ class Hashgraph:
         self._accel_pending = 0
         self._accel_new_witnesses = []
         self._accel_fd_dirty = set()
+        self._round_ctx = {}
         if self.accel is not None:
             # An in-flight sweep's snapshot no longer describes this store.
             self.accel.invalidate()

@@ -44,14 +44,21 @@ class Ticket:
     """One node's submitted window; the batcher delivers (fame, rr) or an
     error. ``done`` is set exactly once."""
 
-    __slots__ = ("win", "result", "error", "done", "batch_size")
+    __slots__ = ("win", "result", "error", "done", "batch_size", "mesh",
+                 "owner")
 
-    def __init__(self, win):
+    def __init__(self, win, mesh=None, owner: Optional[str] = None):
         self.win = win
         self.result = None  # (fame, rr) numpy arrays
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.batch_size = 0  # how many windows shared the dispatch
+        # Coprocessor lane: a configured jax Mesh routes this window to
+        # the sharded program shared by every co-located validator on the
+        # same mesh; owner is the submitting validator's identity (for
+        # the copro_validators multiplexing stat).
+        self.mesh = mesh
+        self.owner = owner
 
 
 class SweepBatcher:
@@ -97,6 +104,14 @@ class SweepBatcher:
         self.compile_kicks = 0
         self.refused = 0  # submissions bounced by backpressure
         self.target_decays = 0  # times the monotone bucket shrank back
+        # Coprocessor (mesh) lane: per-mesh monotone target buckets (the
+        # wave pads every validator's window to ONE aligned shape so the
+        # whole cluster shares each mesh's compile cache) and the distinct
+        # validators multiplexed so far.
+        self._mesh_targets: Dict[tuple, tuple] = {}
+        self.copro_waves = 0  # mesh waves dispatched
+        self.copro_windows = 0  # windows served through a mesh wave
+        self._owners: set = set()  # validators seen on any mesh lane
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="sweep-batcher"
         )
@@ -113,15 +128,18 @@ class SweepBatcher:
     #: than queueing behind a convoy (the admission-slot economics, kept).
     MAX_QUEUE = 32
 
-    def submit(self, win) -> Optional[Ticket]:
+    def submit(self, win, mesh=None,
+               owner: Optional[str] = None) -> Optional[Ticket]:
         """Queue a window for the next coalesced dispatch, or return None
         when the batcher is backlogged — the caller must run its oracle,
-        exactly like losing an admission slot."""
+        exactly like losing an admission slot. With ``mesh`` the window
+        rides the coprocessor lane: one wave of overlapped SHARDED
+        dispatches padded to a shared per-mesh bucket."""
         with self._lock:
             if len(self._pending) >= self.MAX_QUEUE:
                 self.refused += 1
                 return None
-            t = Ticket(win)
+            t = Ticket(win, mesh=mesh, owner=owner)
             self._pending.append(t)
         self._work.set()
         return t
@@ -135,6 +153,11 @@ class SweepBatcher:
             "batch_compile_kicks": self.compile_kicks,
             "batch_refused": self.refused,
             "batch_target_decays": self.target_decays,
+            # coprocessor lane: mesh waves, windows multiplexed through
+            # them, and distinct validators sharing the mesh(es)
+            "copro_waves": self.copro_waves,
+            "copro_windows": self.copro_windows,
+            "copro_validators": len(self._owners),
         }
 
     # -- dispatcher ----------------------------------------------------------
@@ -161,23 +184,45 @@ class SweepBatcher:
                                    exc_info=True)
 
     def _dispatch(self, tickets: List[Ticket]) -> None:
-        group = tickets
-        while len(group) > self.MAX_BATCH:
-            head, group = group[: self.MAX_BATCH], group[self.MAX_BATCH:]
-            self._dispatch_group(head)
-        self._dispatch_group(group)
+        # Partition the wave into lanes: one per configured mesh (the
+        # coprocessor path — every co-located validator on the same mesh
+        # shares its compile cache and padded bucket) plus the
+        # single-device lane. Lanes dispatch independently; a wave can
+        # carry both without cross-contamination.
+        lanes: Dict[Optional[tuple], List[Ticket]] = {}
+        meshes: Dict[tuple, object] = {}
+        for t in tickets:
+            if t.mesh is not None:
+                from babble_tpu.parallel import voting_shard
 
-    def _dispatch_group(self, group: List[Ticket]) -> None:
-        from babble_tpu.ops import voting
+                mk = voting_shard._mesh_key(t.mesh)
+                meshes[mk] = t.mesh
+                lanes.setdefault(mk, []).append(t)
+            else:
+                lanes.setdefault(None, []).append(t)
+        for mk, lane in lanes.items():
+            group = lane
+            while len(group) > self.MAX_BATCH:
+                head, group = group[: self.MAX_BATCH], group[self.MAX_BATCH:]
+                self._dispatch_lane(meshes.get(mk), head)
+            self._dispatch_lane(meshes.get(mk), group)
 
+    def _dispatch_lane(self, mesh, group: List[Ticket]) -> None:
+        if mesh is not None:
+            self._dispatch_mesh_group(mesh, group)
+        else:
+            self._dispatch_group(group)
+
+    def _gate_stale(self, group: List[Ticket]) -> List[Ticket]:
         # Resident-state generation gate: windows snapshotted from a
         # persistent WindowState carry (state, generation). If the state
         # mutated between submit and dispatch (a rebuild, an invalidate),
         # the window's row maps are stale — computing it would hand the
         # owner results it must discard anyway, so fail the ticket now and
         # let that node's oracle carry the flush. This is what keys a
-        # batched wave to the resident-state generation: stale generations
-        # never ride a dispatch.
+        # batched wave to the resident-state generation — and what keeps
+        # one validator's reset from ever corrupting a co-multiplexed
+        # neighbour: stale generations never ride a dispatch.
         fresh: List[Ticket] = []
         for t in group:
             state = getattr(t.win, "state", None)
@@ -191,7 +236,84 @@ class SweepBatcher:
                 t.done.set()
                 continue
             fresh.append(t)
-        group = fresh
+        return fresh
+
+    def _dispatch_mesh_group(self, mesh, group: List[Ticket]) -> None:
+        """Coprocessor wave: every validator's window re-pads to ONE
+        mesh-aligned monotone bucket and launches through the shared
+        per-mesh sharded program — launch all, read all, so the device
+        overlaps the windows' work and the wave pays ~one readback. The
+        padding rule is the batcher's (elementwise-max bucket, neutral
+        fills) with the witness axis grown until the mesh size divides
+        it; the compile cache is voting_shard's per-mesh jit, shared by
+        every validator on this mesh."""
+        from babble_tpu.ops import voting
+        from babble_tpu.parallel import voting_shard
+
+        group = self._gate_stale(group)
+        if not group:
+            return
+        for t in group:
+            if t.owner is not None:
+                self._owners.add(t.owner)
+        keys = [voting.bucket_key(t.win) for t in group]
+        wave = tuple(max(k[d] for k in keys) for d in range(5))
+        n = int(mesh.devices.size)
+        W_m = wave[0]
+        while W_m % n and W_m <= wave[0] * n:
+            # doubling a power-of-two W can never reach a multiple of a
+            # mesh with an odd factor; cap the climb and launch unaligned
+            # (the per-ticket try/except below converts the shard error
+            # into a ticket failure -> the owner's oracle path)
+            W_m *= 2
+        if W_m % n == 0:
+            wave = (W_m,) + wave[1:]
+        mk = voting_shard._mesh_key(mesh)
+        prev = self._mesh_targets.get(mk)
+        if prev is not None:
+            wave = tuple(max(a, b) for a, b in zip(wave, prev))
+        self._mesh_targets[mk] = wave
+        launched = []
+        for t in group:
+            try:
+                padded = voting.repad_window(t.win, wave)
+                launched.append((
+                    t, padded,
+                    voting_shard._jitted(mesh)(
+                        *voting_shard.place_window(mesh, padded)
+                    ),
+                ))
+            except BaseException as err:
+                t.error = err
+                t.done.set()
+        import numpy as np
+
+        served = 0
+        for t, padded, out in launched:
+            try:
+                host = np.asarray(out)
+                # real rows keep their indexes under repad: slice back to
+                # the ORIGINAL window's row spaces
+                t.result = (
+                    host[: t.win.n_witnesses],
+                    host[padded.n_witnesses:
+                         padded.n_witnesses + t.win.n_events],
+                )
+                t.batch_size = len(launched)
+                served += 1
+            except BaseException as err:
+                t.error = err
+            t.done.set()
+        if served:
+            self.copro_waves += 1
+            self.copro_windows += served
+            self.windows += served
+            self.max_batch_seen = max(self.max_batch_seen, served)
+
+    def _dispatch_group(self, group: List[Ticket]) -> None:
+        from babble_tpu.ops import voting
+
+        group = self._gate_stale(group)
         if not group:
             return
 

@@ -180,7 +180,13 @@ class Core:
             from ..hashgraph.accel import TensorConsensus
 
             self.accelerator_mesh = accelerator_mesh
-            self.hg.accel = TensorConsensus(clock=self.clock)
+            # The owner identity keys the coprocessor's per-validator
+            # accounting when several co-located validators multiplex
+            # their sweep windows onto one shared mesh.
+            self.hg.accel = TensorConsensus(
+                clock=self.clock,
+                owner=validator.moniker or validator.public_key_hex(),
+            )
 
         # Telemetry (docs/observability.md): the per-node registry wiring
         # every subsystem's counters into instruments, created at the

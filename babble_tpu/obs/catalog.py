@@ -417,6 +417,31 @@ CATALOG: Tuple[Instrument, ...] = (
         "Window rows served from device-resident buffers.",
     ),
     Instrument(
+        "accel_mesh_pad_rows_total", _C, (), "accel",
+        "Witness rows padded onto windows to align the W axis with the "
+        "mesh shard count.",
+    ),
+    Instrument(
+        "accel_mesh_fallbacks_total", _C, (), "accel",
+        "Mesh sweeps that fell back to the single-device program "
+        "(unaligned window that could not be padded).",
+    ),
+    Instrument(
+        "copro_waves_total", _C, (), "accel",
+        "Coprocessor dispatch waves: batched sweep launches over a "
+        "shared device mesh (process-wide).",
+    ),
+    Instrument(
+        "copro_windows_total", _C, (), "accel",
+        "Validator windows multiplexed through coprocessor waves "
+        "(process-wide).",
+    ),
+    Instrument(
+        "copro_validators", _G, (), "accel",
+        "Distinct validators that have shared the coprocessor mesh "
+        "(process-wide).",
+    ),
+    Instrument(
         "accel_breaker_state", _G, (), "accel",
         "Circuit-breaker state: 0=closed, 1=half_open, 2=open.",
     ),

@@ -322,6 +322,24 @@ class NodeTelemetry:
             "accel_rows_reused_total", lambda: accel.rows_reused_total
         )
         self._func(
+            "accel_mesh_pad_rows_total", lambda: accel.mesh_pad_rows
+        )
+        self._func(
+            "accel_mesh_fallbacks_total", lambda: accel.mesh_fallbacks
+        )
+
+        def _copro(key: str, default=0):
+            from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+            b = SweepBatcher._instance
+            return b.stats().get(key, default) if b is not None else default
+
+        self._func("copro_waves_total", lambda: _copro("copro_waves"))
+        self._func("copro_windows_total", lambda: _copro("copro_windows"))
+        self._func(
+            "copro_validators", lambda: _copro("copro_validators")
+        )
+        self._func(
             "accel_breaker_state",
             lambda: {"closed": 0, "half_open": 1, "open": 2}.get(
                 accel.breaker.stats()["breaker_state"], -1

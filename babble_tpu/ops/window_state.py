@@ -222,7 +222,13 @@ class WindowState:
     """Persistent incremental window for ONE hashgraph (owned by its
     TensorConsensus). All methods run on the consensus thread."""
 
-    def __init__(self) -> None:
+    def __init__(self, mesh=None) -> None:
+        # Optional jax.sharding.Mesh: residency lives as per-shard device
+        # buffers (parallel/voting_shard.py shardings) and dispatch runs
+        # the sharded resident program; None keeps the single-device
+        # program. The W bucket is aligned to the mesh size at rebuild so
+        # the witness axis always divides the shard count.
+        self.mesh = mesh
         self.generation = 0  # bumped on every mirror mutation or rebuild
         self.dirty = True  # force a rebuild on the next snapshot
         self.dirty_reason = "initial"
@@ -351,6 +357,19 @@ class WindowState:
             S0,
             head(R_real, R0, 8, 2),
         )
+        if self.mesh is not None:
+            # the sharded sweep splits the witness axis over every device:
+            # align the W bucket so it always divides the mesh size (both
+            # are powers of two in practice; a mesh with an odd factor can
+            # never divide a doubled power-of-two bucket, so cap the climb
+            # at one doubling past W*n and leave the bucket unaligned —
+            # the dispatch layer falls back to the single program)
+            n = int(self.mesh.devices.size)
+            W_m = key[0]
+            while W_m % n and W_m <= key[0] * n:
+                W_m *= 2
+            if W_m % n == 0:
+                key = (W_m,) + key[1:]
         win = voting.repad_window(win, key)
         self.mirror = {f: np.asarray(getattr(win, f)) for f in RESIDENT_FIELDS}
         self.row = dict(win.row)
@@ -722,6 +741,8 @@ class WindowState:
         through the plain fused program and keep the uploaded buffers as
         the new residency seed. Returns the unread [fame | rr] device
         buffer. Returns (out, used_delta)."""
+        if self.mesh is not None:
+            return self._dispatch_mesh(snap, allow_inline_compile)
         key = self.key
         win = snap.win
         if (
@@ -752,4 +773,50 @@ class WindowState:
             self.mark_dirty("dispatch-error")
             raise
         self.device = bufs
+        return out, False
+
+    # index of each RESIDENT_FIELD inside voting._WIN_FIELDS order — the
+    # mesh full-upload path keeps those placed operands as the residency
+    # seed (creator, index, rounds, undet, wit_idx, la_w, fd_w, rounds_w,
+    # valid_w, fame0_w, mid_w)
+    _PLACED_RESIDENT_IDX = (0, 1, 13, 14, 8, 2, 3, 4, 5, 6, 7)
+
+    def _dispatch_mesh(self, snap: Snapshot, allow_inline_compile: bool):
+        """Mesh variant of dispatch: residency is a tuple of per-shard
+        device buffers (voting_shard.resident_shardings), the delta path
+        donates them to the sharded resident program, and the full path
+        seeds them by placing the mirrors with the sweep's shardings.
+        Same ownership rules as the single-device path."""
+        from babble_tpu.parallel import voting_shard as vshard
+
+        mesh = self.mesh
+        key = self.key
+        win = snap.win
+        if (
+            snap.delta is not None
+            and self.device is not None
+            and (allow_inline_compile
+                 or vshard.resident_bucket_ready(mesh, key))
+        ):
+            bufs, self.device = self.device, None  # consume: donation
+            fresh = tuple(np.asarray(getattr(win, f)) for f in FRESH_FIELDS)
+            try:
+                new_bufs, out = vshard.resident_jitted(mesh)(
+                    *bufs, *snap.delta, *fresh
+                )
+            except BaseException:
+                self.mark_dirty("dispatch-error")
+                raise
+            vshard.mark_resident_bucket_ready(mesh, key)
+            self.device = tuple(new_bufs)
+            return out, True
+        # full upload through the plain sharded sweep; the placed per-row
+        # operands seed residency for the next delta sweep
+        placed = vshard.place_window(mesh, win)
+        try:
+            out = vshard._jitted(mesh)(*placed)
+        except BaseException:
+            self.mark_dirty("dispatch-error")
+            raise
+        self.device = tuple(placed[i] for i in self._PLACED_RESIDENT_IDX)
         return out, False

@@ -14,7 +14,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+# jax >= 0.6 exposes shard_map at top level and spells the replication
+# check `check_vma`; 0.4.x keeps it experimental as `check_rep`. Normalize
+# both here so kernel code can target the modern spelling.
+_raw_shard_map = getattr(jax, "shard_map", None)
+if _raw_shard_map is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_raw_shard_map).parameters:
+    shard_map = _raw_shard_map
+else:  # pragma: no cover - version-dependent
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _raw_shard_map(*args, **kwargs)
 
 
 def sharded_strongly_see(mesh: Mesh, super_majority: int):

@@ -34,7 +34,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from babble_tpu.ops.voting import COIN_ROUND_FREQ, VotingWindow
 
-shard_map = jax.shard_map
+from babble_tpu.parallel.collectives import shard_map  # version-normalized
+
+if hasattr(lax, "pcast"):
+    _pcast = lax.pcast
+else:  # pragma: no cover - version-dependent
+    # jax 0.4.x has no varying-manual-axes (vma) type system; with the
+    # replication check off, marking a carry device-varying is a no-op.
+    def _pcast(x, axes, to):
+        return x
 
 AXES = ("dp", "sp")
 
@@ -126,7 +134,7 @@ def sharded_sweep_fn(mesh: Mesh):
         W = rounds_full.shape[0]
         # mark the all-zeros initial carry as device-varying so the loop
         # carry types line up (shard_map varying-manual-axes rule)
-        votes0 = lax.pcast(jnp.zeros((W_loc, W), bool), AXES, to="varying")
+        votes0 = _pcast(jnp.zeros((W_loc, W), bool), AXES, to="varying")
         _, fame_full = lax.fori_loop(1, R, per_round, (votes0, fame0_full))
 
         # per-round decidedness (oracle: roundInfo.go:78-96) — replicated
@@ -160,8 +168,8 @@ def sharded_sweep_fn(mesh: Mesh):
             blocked = blocked | (relevant & hard_block_r[i])
             return rr, blocked
 
-        rr0 = lax.pcast(jnp.full(E, -1, jnp.int32), AXES, to="varying")
-        blocked0 = lax.pcast(jnp.zeros(E, bool), AXES, to="varying")
+        rr0 = _pcast(jnp.full(E, -1, jnp.int32), AXES, to="varying")
+        blocked0 = _pcast(jnp.zeros(E, bool), AXES, to="varying")
         rr, _ = lax.fori_loop(1, R, per_round_rr, (rr0, blocked0))
         return jnp.concatenate([fame_full, rr])
 
@@ -245,6 +253,123 @@ def _jitted(mesh: Mesh):
         fn = jax.jit(sharded_sweep_fn(mesh))
         _jit_cache[key] = fn
     return fn
+
+
+def resident_shardings(mesh: Mesh) -> tuple:
+    """NamedShardings for the 11 resident buffers in RESIDENT_FIELDS order
+    (ops.window_state): per-event vectors and the candidate-axis witness
+    index replicated, witness coordinate rows W-sharded like the sweep's
+    in_specs, so the resident buffers ARE the sweep's operands — no
+    resharding between the delta scatter and the kernel."""
+    w_sh = NamedSharding(mesh, P(AXES))
+    w2_sh = NamedSharding(mesh, P(AXES, None))
+    rep = NamedSharding(mesh, P(None))
+    # (creator, index, rounds, undet, wit_idx,
+    #  la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w)
+    return (rep, rep, rep, rep, rep, w2_sh, w2_sh, w_sh, w_sh, w_sh, w_sh)
+
+
+def resident_sweep_fn(mesh: Mesh):
+    """The mesh analogue of ops.window_state._resident_core: scatter a
+    bucket-padded delta into the per-shard resident buffers (GSPMD keeps
+    the scatter local — delta row indexes are replicated, the W-sharded
+    operands stay put), then run the SHARDED sweep over them. Returns
+    (new resident buffers, replicated [fame | rr])."""
+    sweep = sharded_sweep_fn(mesh)
+
+    def fn(creator, index, rounds, undet, wit_idx, la_w, fd_w,
+           rounds_w, valid_w, fame0_w, mid_w,
+           e_idx, e_creator, e_index, e_rounds, e_undet,
+           w_idx, w_wit_idx, w_la, w_fd, w_rounds, w_valid,
+           w_fame0, w_mid,
+           member, sm_s, psi, sm_r, exists_r, prior_dec_r, lb_gate_r):
+        creator = creator.at[e_idx].set(e_creator, mode="drop")
+        index = index.at[e_idx].set(e_index, mode="drop")
+        rounds = rounds.at[e_idx].set(e_rounds, mode="drop")
+        undet = undet.at[e_idx].set(e_undet, mode="drop")
+        wit_idx = wit_idx.at[w_idx].set(w_wit_idx, mode="drop")
+        la_w = la_w.at[w_idx].set(w_la, mode="drop")
+        fd_w = fd_w.at[w_idx].set(w_fd, mode="drop")
+        rounds_w = rounds_w.at[w_idx].set(w_rounds, mode="drop")
+        valid_w = valid_w.at[w_idx].set(w_valid, mode="drop")
+        fame0_w = fame0_w.at[w_idx].set(w_fame0, mode="drop")
+        mid_w = mid_w.at[w_idx].set(w_mid, mode="drop")
+        out = sweep(
+            creator, index, la_w, fd_w, rounds_w, valid_w, fame0_w, mid_w,
+            wit_idx, member, sm_s, psi, sm_r, rounds, undet,
+            exists_r, prior_dec_r, lb_gate_r,
+        )
+        return (
+            (creator, index, rounds, undet, wit_idx, la_w, fd_w, rounds_w,
+             valid_w, fame0_w, mid_w),
+            out,
+        )
+
+    return fn
+
+
+# per-mesh jitted resident program: donates the 11 sharded buffers (the
+# delta updates them in place per shard) and pins their output shardings
+# so residency never drifts placement between sweeps
+_resident_jit_cache: dict = {}
+
+
+def resident_jitted(mesh: Mesh):
+    key = _mesh_key(mesh)
+    fn = _resident_jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(
+            resident_sweep_fn(mesh),
+            donate_argnums=tuple(range(11)),
+            out_shardings=(
+                resident_shardings(mesh),
+                NamedSharding(mesh, P(None)),
+            ),
+        )
+        _resident_jit_cache[key] = fn
+    return fn
+
+
+def place_resident(mesh: Mesh, win) -> tuple:
+    """Device-place a window's 11 per-row arrays with the resident
+    shardings (RESIDENT_FIELDS order) — the residency seed the full-upload
+    dispatch path keeps for the next delta sweep."""
+    from babble_tpu.ops.window_state import RESIDENT_FIELDS
+
+    shardings = resident_shardings(mesh)
+    return tuple(
+        jax.device_put(np.asarray(getattr(win, f)), s)
+        for f, s in zip(RESIDENT_FIELDS, shardings)
+    )
+
+
+# per-mesh compiled-bucket registry for the resident delta program
+# (a separate executable from the plain sharded sweep)
+_ready_resident: dict = {}
+
+
+def resident_bucket_ready(mesh: Mesh, key: tuple) -> bool:
+    return key in _ready_resident.get(_mesh_key(mesh), set())
+
+
+def mark_resident_bucket_ready(mesh: Mesh, key: tuple) -> None:
+    _ready_resident.setdefault(_mesh_key(mesh), set()).add(key)
+
+
+def precompile_resident(mesh: Mesh, W: int, E: int, P_: int, S: int,
+                        R: int) -> None:
+    """Compile the mesh resident delta program for a shape bucket: dummy
+    window placed with the resident shardings + an all-padding delta."""
+    from babble_tpu.ops.voting import dummy_window
+    from babble_tpu.ops.window_state import FRESH_FIELDS, _empty_delta
+
+    key = (W, E, P_, S, R)
+    win = dummy_window(*key)
+    bufs = place_resident(mesh, win)
+    fresh = tuple(np.asarray(getattr(win, f)) for f in FRESH_FIELDS)
+    _new_bufs, out = resident_jitted(mesh)(*bufs, *_empty_delta(key), *fresh)
+    np.asarray(out)  # block until the executable is really ready
+    mark_resident_bucket_ready(mesh, key)
 
 
 # per-mesh compiled-bucket registry, mirroring ops.voting's single-device

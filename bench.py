@@ -479,6 +479,258 @@ def bench_dag_incremental(n_peers: int = 16, n_events: int = 512,
     return out
 
 
+def _ensure_mesh_devices(n_devices: int = 8) -> bool:
+    """Ensure >= n_devices jax devices for the mesh arms, forcing the
+    virtual CPU backend when the host lacks real chips — the same
+    self-sufficient pattern as __graft_entry__.dryrun_multichip (XLA_FLAGS
+    is read lazily at first backend init, jax_platforms can be switched
+    until a computation runs). MUST run before any other jax use in the
+    process or the backend is already locked to the real device count.
+    Returns whether the mesh is actually available."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None or int(m.group(1)) < n_devices:
+        if m is not None:
+            flags = flags.replace(m.group(0), "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    try:
+        if len(jax.devices()) >= n_devices:
+            return True
+        # backend already initialized below the target — too late to force
+        return False
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        return len(jax.devices()) >= n_devices
+    except Exception:
+        return False
+
+
+def bench_dag_mesh(n_peers: int = 16, n_events: int = 512, chunk: int = 32,
+                   seed: int = 5, warm: bool = True) -> dict:
+    """Mesh arm of the dag microbench (ISSUE 17): the SAME synthetic
+    stream swept three ways —
+
+    - ``single_resident``: single-device incremental WindowState (the
+      bench_dag_incremental fast arm, re-measured here as the reference),
+    - ``mesh_resident``: per-shard donated resident buffers + the sharded
+      delta program (shard_map over the witness axis),
+    - ``mesh_rebuild``: the sharded sweep with a full place_window upload
+      per sweep (the correctness oracle for residency, and the transfer
+      cost the delta path avoids).
+
+    All three must commit identical blocks (``consensus_match``). On the
+    virtual CPU mesh this measures dispatch/packing ECONOMICS (shard_map
+    partitioning overheads, delta-vs-full transfer), not a real-chip
+    speedup — collectives on one host are memcpys."""
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+    from babble_tpu.hashgraph.accel import TensorConsensus
+    from babble_tpu.parallel.mesh import consensus_mesh
+
+    if not _ensure_mesh_devices(8):
+        return {"error": "mesh unavailable (jax backend already "
+                         "initialized below 8 devices)"}
+    mesh = consensus_mesh(8)
+    events, peers = _synthetic_stream(n_peers, n_events, seed=seed)
+
+    def run(mesh_, resident):
+        acc = TensorConsensus(sweep_events=chunk, async_compile=False,
+                              min_window=0, pipeline=False, batcher=False,
+                              resident=resident, mesh=mesh_)
+        h = Hashgraph(InmemStore(100000))
+        h.init(peers)
+        h.accel = acc
+        per_sweep = []
+        seen = 0
+        t0 = time.perf_counter()
+        for ev in events:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            h.insert_event_and_run_consensus(e, set_wire_info=True)
+            if acc.sweeps != seen:
+                seen = acc.sweeps
+                per_sweep.append(acc.last_sweep_s)
+        h.flush_consensus()
+        if acc.sweeps != seen:
+            per_sweep.append(acc.last_sweep_s)
+        return h, acc, time.perf_counter() - t0, per_sweep
+
+    arms_cfg = (
+        ("single_resident", None, True),
+        ("mesh_resident", mesh, True),
+        ("mesh_rebuild", mesh, False),
+    )
+    arms = {}
+    chains = {}
+    for label, m_, r_ in arms_cfg:
+        if warm:
+            run(m_, r_)
+        h, acc, wall, per_sweep = run(m_, r_)
+        med = sorted(per_sweep)[len(per_sweep) // 2] if per_sweep else 0.0
+        arms[label] = {
+            "median_ms_per_sweep": round(1e3 * med, 3),
+            "sweeps": acc.sweeps,
+            "fallbacks": acc.fallbacks,
+            "rows_reused": acc.rows_reused_total,
+            "pad_rows": acc.mesh_pad_rows,
+            "mesh_fallbacks": acc.mesh_fallbacks,
+            "wall_s": round(wall, 2),
+        }
+        import hashlib
+
+        d = hashlib.sha256()
+        for b in range(h.store.last_block_index() + 1):
+            d.update(
+                json.dumps(h.store.get_block(b).body.to_dict(), default=repr,
+                           sort_keys=True).encode()
+            )
+        chains[label] = (h.store.last_block_index(), d.hexdigest()[:16])
+
+    match = len(set(chains.values())) == 1 and all(
+        a["fallbacks"] == 0 for a in arms.values()
+    )
+
+    def ratio(a, b):
+        return (
+            round(arms[a]["median_ms_per_sweep"]
+                  / arms[b]["median_ms_per_sweep"], 2)
+            if arms[b]["median_ms_per_sweep"] > 0 else None
+        )
+
+    return {
+        "n_peers": n_peers,
+        "n_events": n_events,
+        "chunk": chunk,
+        "arms": arms,
+        "consensus_match": bool(match),
+        # mesh_rebuild / mesh_resident: what per-shard residency saves
+        "resident_vs_rebuild": ratio("mesh_rebuild", "mesh_resident"),
+        # mesh_resident / single_resident: the CPU-mesh dispatch overhead
+        # a real multi-chip topology would amortize
+        "mesh_vs_single": ratio("mesh_resident", "single_resident"),
+    }
+
+
+def bench_copro(n_events: int = 200, seed: int = 5) -> dict:
+    """Coprocessor smoke (`make coprosmoke`): two in-process validators
+    with DIFFERENT peer sets multiplex their sweep windows through ONE
+    shared CPU-XLA mesh via the SweepBatcher's mesh lane. Asserts
+
+    - parity: each validator's blocks equal its own pure-oracle replay,
+    - accounting: both owners cross the coprocessor lane
+      (copro_windows/copro_validators),
+    - breaker: a validator whose mesh dispatch is wedged trips the accel
+      circuit breaker and converges through the oracle path anyway."""
+    from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+    from babble_tpu.hashgraph.accel import TensorConsensus
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+    from babble_tpu.parallel.mesh import consensus_mesh
+
+    if not _ensure_mesh_devices(8):
+        return {"error": "mesh unavailable"}
+    mesh = consensus_mesh(8)
+
+    def replay(acc, events, peers):
+        h = Hashgraph(InmemStore(100000))
+        h.init(peers)
+        h.accel = acc
+        t0 = time.perf_counter()
+        for ev in events:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            h.insert_event_and_run_consensus(e, set_wire_info=True)
+        h.flush_consensus()
+        return h, time.perf_counter() - t0
+
+    def chain(h):
+        import hashlib
+
+        d = hashlib.sha256()
+        for b in range(h.store.last_block_index() + 1):
+            d.update(
+                json.dumps(h.store.get_block(b).body.to_dict(), default=repr,
+                           sort_keys=True).encode()
+            )
+        return h.store.last_block_index(), d.hexdigest()[:16]
+
+    ev1, p1 = _synthetic_stream(8, n_events, seed=seed)
+    ev2, p2 = _synthetic_stream(6, n_events, seed=seed + 7)
+
+    base = SweepBatcher.instance().stats()
+    a1 = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                         pipeline=False, batcher=True, resident=False,
+                         mesh=mesh, owner="copro-bench-1")
+    a2 = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                         pipeline=False, batcher=True, resident=False,
+                         mesh=mesh, owner="copro-bench-2")
+    h1, wall1 = replay(a1, ev1, p1)
+    h2, wall2 = replay(a2, ev2, p2)
+
+    parity = True
+    for events, peers, h in ((ev1, p1, h1), (ev2, p2, h2)):
+        o = Hashgraph(InmemStore(100000))
+        o.init(peers)
+        for ev in events:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            o.insert_event_and_run_consensus(e, set_wire_info=True)
+        parity = parity and chain(h) == chain(o)
+    stats = SweepBatcher.instance().stats()
+
+    # Breaker trip: wedge a third validator's device dispatch entirely —
+    # every sweep attempt fails, the accel circuit breaker opens, and the
+    # oracle path must still converge to the reference consensus.
+    from babble_tpu.common.breaker import CircuitBreaker
+
+    a3 = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                         pipeline=False, batcher=False, resident=False,
+                         mesh=mesh, owner="copro-bench-wedged")
+    a3.breaker = CircuitBreaker(threshold=2, window_s=60.0, cooldown_s=60.0)
+
+    def wedged_dispatch(win):
+        raise RuntimeError("injected mesh dispatch failure (coprosmoke)")
+
+    a3._dispatch = wedged_dispatch
+    a3._dispatch_snap = lambda win, snap: wedged_dispatch(win)
+    ev3, p3 = _synthetic_stream(6, max(120, n_events // 2), seed=seed + 13)
+    h3, _wall3 = replay(a3, ev3, p3)
+    o3 = Hashgraph(InmemStore(100000))
+    o3.init(p3)
+    for ev in ev3:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        o3.insert_event_and_run_consensus(e, set_wire_info=True)
+    breaker_tripped = a3.breaker.opens >= 1
+    breaker_parity = chain(h3) == chain(o3)
+
+    return {
+        "validators": 2,
+        "parity": bool(parity),
+        "copro_windows": stats["copro_windows"] - base["copro_windows"],
+        "copro_waves": stats["copro_waves"] - base["copro_waves"],
+        "copro_validators": stats["copro_validators"],
+        "wall_s": round(wall1 + wall2, 2),
+        "breaker_tripped": bool(breaker_tripped),
+        "breaker_fallbacks": a3.fallbacks,
+        "breaker_parity": bool(breaker_parity),
+        "blocks": [
+            int(h1.store.last_block_index()),
+            int(h2.store.last_block_index()),
+        ],
+    }
+
+
 def bench_dag_pipeline(n_peers: int = 16, n_events: int = 512, reps: int = 10):
     """Events/s through the jitted consensus sweep on the default device."""
     import jax
@@ -2364,12 +2616,55 @@ def main_dag(smoke: bool = False) -> None:
     """`make benchdag` / `make benchdagsmoke`: the dag_pipeline microbench
     in full-rebuild vs incremental (resident) mode with the per-stage
     breakdown on stderr and ONE parseable JSON line on stdout."""
+    # The mesh arm forces the 8-device virtual CPU backend; that must
+    # happen before the single-device arms initialize jax or the forcing
+    # silently fails (backend locks on first device query).
+    mesh_ok = _ensure_mesh_devices(8)
     if smoke:
         # long enough that steady-state sweeps outnumber the growth-phase
         # rebuilds, small enough for CI
         res = bench_dag_incremental(n_peers=8, n_events=320, chunk=16)
+        mesh_cells = [(8, 320, 16)] if mesh_ok else []
     else:
         res = bench_dag_incremental()
+        # ISSUE-17 grid: single-device resident vs mesh resident vs mesh
+        # rebuild across the P x E corners
+        mesh_cells = (
+            [(16, 512, 32), (64, 512, 32),
+             (16, 16384, 512), (64, 16384, 512)]
+            if mesh_ok else []
+        )
+    mesh_res = {}
+    for (mp, me, mc) in mesh_cells:
+        cell = bench_dag_mesh(n_peers=mp, n_events=me, chunk=mc)
+        mesh_res[f"P{mp}_E{me}"] = cell
+        print(
+            f"dag mesh P={mp} E={me}: "
+            + ", ".join(
+                f"{k}={v['median_ms_per_sweep']}ms"
+                for k, v in cell.get("arms", {}).items()
+            )
+            + f", resident_vs_rebuild={cell.get('resident_vs_rebuild')}x"
+            f", mesh_vs_single={cell.get('mesh_vs_single')}x"
+            f", match={cell.get('consensus_match')}",
+            file=sys.stderr,
+        )
+    if mesh_res:
+        first = next(iter(mesh_res.values()))
+        res["mesh"] = {
+            "cells": {
+                k: {
+                    "resident_vs_rebuild": c.get("resident_vs_rebuild"),
+                    "mesh_vs_single": c.get("mesh_vs_single"),
+                    "consensus_match": c.get("consensus_match"),
+                }
+                for k, c in mesh_res.items()
+            },
+            "arms_first_cell": {
+                k: v["median_ms_per_sweep"]
+                for k, v in first.get("arms", {}).items()
+            },
+        }
     for label in ("full_rebuild", "incremental"):
         r = res[label]
         print(
@@ -2392,7 +2687,50 @@ def main_dag(smoke: bool = False) -> None:
         {"bench_summary": "dag_smoke" if smoke else "dag", **res},
         separators=(",", ":"),
     )
+    if len(line) >= 2000:
+        # shed the per-cell arm detail first (the ledger keeps it)
+        slim = dict(res)
+        slim["mesh"] = {"cells": res.get("mesh", {}).get("cells", {})}
+        line = json.dumps(
+            {"bench_summary": "dag_smoke" if smoke else "dag", **slim},
+            separators=(",", ":"),
+        )
     assert len(line) < 2000, "dag summary exceeded tail-capture budget"
+    print(line)
+
+
+def main_copro(smoke: bool = False) -> None:
+    """`python bench.py --copro [--smoke]` / `make coprosmoke`: the
+    multi-validator consensus coprocessor — two in-process validators
+    sharing one CPU-XLA mesh through the SweepBatcher's mesh lane, plus
+    the wedged-dispatch breaker drill. Hard-asserts parity and the
+    breaker trip (this is the CI gate), then prints ONE JSON line."""
+    res = bench_copro(n_events=160 if smoke else 320)
+    if "error" in res:
+        print(f"copro bench unavailable: {res['error']}", file=sys.stderr)
+        print(json.dumps({"bench_summary": "copro", **res},
+                         separators=(",", ":")))
+        return
+    print(
+        f"copro: {res['copro_windows']} windows over "
+        f"{res['copro_waves']} mesh waves from "
+        f"{res['copro_validators']} validators, parity={res['parity']}, "
+        f"breaker_tripped={res['breaker_tripped']} "
+        f"(fallbacks={res['breaker_fallbacks']}, "
+        f"parity={res['breaker_parity']})",
+        file=sys.stderr,
+    )
+    assert res["parity"], "coprocessor validator diverged from its oracle"
+    assert res["copro_windows"] > 0, "mesh lane never dispatched"
+    assert res["copro_validators"] >= 2, "owner accounting missed a validator"
+    assert res["breaker_tripped"], "wedged dispatch never tripped the breaker"
+    assert res["breaker_parity"], "breaker fallback diverged from oracle"
+    _ledger_append("copro_smoke" if smoke else "copro", res)
+    line = json.dumps(
+        {"bench_summary": "copro_smoke" if smoke else "copro", **res},
+        separators=(",", ":"),
+    )
+    assert len(line) < 2000, "copro summary exceeded tail-capture budget"
     print(line)
 
 
@@ -2635,6 +2973,8 @@ def main() -> None:
         return main_nodes16proc()
     if "--dag" in sys.argv:
         return main_dag("--smoke" in sys.argv)
+    if "--copro" in sys.argv:
+        return main_copro("--smoke" in sys.argv)
     if "--clients" in sys.argv:
         return main_clients("--smoke" in sys.argv)
     if "--mempool" in sys.argv:

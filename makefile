@@ -44,6 +44,13 @@ benchdag:
 benchdagsmoke:
 	JAX_PLATFORMS=cpu python bench.py --dag --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d.get('consensus_match') is True, d; assert d['incremental']['stage_ms_per_sweep'], d; print('benchdagsmoke ok: snapshot', str(d['speedup_snapshot']) + 'x,', 'rebuilds', d['incremental']['rebuilds'])"
 
+# coprosmoke: multi-validator consensus coprocessor smoke — two
+# in-process validators share one 8-device virtual CPU mesh through the
+# sweep batcher's mesh lane; asserts per-validator consensus parity,
+# owner accounting, and the wedged-dispatch breaker trip (ISSUE 17)
+coprosmoke:
+	JAX_PLATFORMS=cpu python bench.py --copro --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d.get('parity') is True, d; assert d.get('breaker_tripped') is True, d; assert d.get('copro_validators', 0) >= 2, d; print('coprosmoke ok:', d['copro_windows'], 'windows /', d['copro_waves'], 'waves from', d['copro_validators'], 'validators')"
+
 # mempoolsmoke: seeded overload smoke — submit ≥10x the commit rate
 # against a small admission cap; asserts bounded pending, a nonzero shed
 # rate, no lost/duplicated accepted txs, and committed throughput held
@@ -202,4 +209,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint staticcheck perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke coprosmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint staticcheck perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel

@@ -1,0 +1,344 @@
+"""Mesh consensus coprocessor (resident sharded sweeps + multi-validator
+window multiplexing).
+
+Pinned properties:
+
+- **Mesh-resident parity**: with a mesh attached, the incremental
+  WindowState keeps per-shard donated buffers and dispatches deltas
+  through the sharded resident program — its mirrors and decisions must
+  equal the single-device from-scratch rebuild oracle after every
+  snapshot, under churn and peer-set changes, and the delta path must
+  actually run (not silently fall back to full uploads).
+- **Generation safety under mesh**: a stale readback (resident state
+  mutated after launch) is detected and dropped on the mesh path exactly
+  like the single-device path.
+- **Coprocessor isolation**: two validators multiplexing their sweep
+  windows through ONE shared mesh each converge to their own oracle's
+  exact consensus state; a wave serves multiple windows; per-validator
+  accounting surfaces in the batcher stats.
+- **W-axis padding, not fallback**: a window whose witness axis the mesh
+  size does not divide is padded (counted in accel_mesh_pad_rows) and
+  still sharded; only an impossible alignment (odd-factor mesh) counts
+  an accel_mesh_fallback and rides the single-device program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+from babble_tpu.hashgraph.accel import TensorConsensus
+from babble_tpu.ops import voting
+from babble_tpu.ops import window_state as ws
+
+from tests.test_incremental_window import _assert_equiv, _stream
+
+
+def _mesh8():
+    from babble_tpu.parallel.mesh import consensus_mesh
+
+    return consensus_mesh(8)
+
+
+def _replay_through(acc, events, peers, peer_change_round=None,
+                    removed_peer=None):
+    h = Hashgraph(InmemStore(100000))
+    h.init(peers)
+    if peer_change_round is not None:
+        h.store.set_peer_set(
+            peer_change_round, peers.with_removed_peer(removed_peer)
+        )
+    h.accel = acc
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        h.insert_event_and_run_consensus(e, set_wire_info=True)
+    h.flush_consensus()
+    return h
+
+
+def _blocks(h) -> list:
+    return [
+        h.store.get_block(b).body.hash()
+        for b in range(h.store.last_block_index() + 1)
+    ]
+
+
+def test_mesh_resident_parity_under_churn():
+    """Incremental mesh-resident state == single-device rebuild oracle
+    after EVERY snapshot, and the sharded delta program actually runs."""
+    events, peers, _keys = _stream(n_peers=6, n_events=200, seed=3)
+    acc = TensorConsensus(sweep_events=8, async_compile=False,
+                          min_window=0, pipeline=False, batcher=False,
+                          resident=True, mesh=_mesh8())
+
+    checked = {"count": 0}
+    orig = ws.WindowState.snapshot
+
+    def snapshot_checked(self, hg, timers, copy_rows=False):
+        snap = orig(self, hg, timers, copy_rows)
+        if snap is not None:
+            _assert_equiv(self, snap.win, hg)
+            checked["count"] += 1
+        return snap
+
+    ws.WindowState.snapshot = snapshot_checked
+    try:
+        h = _replay_through(acc, events, peers)
+    finally:
+        ws.WindowState.snapshot = orig
+
+    oracle = Hashgraph(InmemStore(100000))
+    oracle.init(peers)
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        oracle.insert_event_and_run_consensus(e, set_wire_info=True)
+
+    assert checked["count"] > 0, "no snapshot was ever checked"
+    assert _blocks(h) == _blocks(oracle)
+    assert h.store.last_block_index() >= 0, "stream decided nothing"
+    s = acc.stats()
+    assert s["accel_sweeps"] > 0
+    assert s["accel_fallbacks"] == 0
+    assert s["accel_rows_reused"] > 0, "mesh delta path never used"
+    # every resident buffer must live on all 8 devices (sharded or
+    # replicated — never single-device residency under a mesh)
+    state = acc.window_state
+    assert state is not None and state.device is not None
+    for buf in state.device:
+        assert len(buf.sharding.device_set) == 8
+
+
+def test_mesh_resident_parity_with_peer_set_change():
+    """The multi-slot psi/member machinery survives the mesh path: a
+    peer-set change at round 3 flows through sharded delta sweeps with
+    rebuild-oracle equality throughout."""
+    events, peers, _keys = _stream(n_peers=6, n_events=140, seed=12)
+    acc = TensorConsensus(sweep_events=7, async_compile=False,
+                          min_window=0, pipeline=False, batcher=False,
+                          resident=True, mesh=_mesh8())
+
+    seen_slots = {"max": 0}
+    orig = ws.WindowState.snapshot
+
+    def snapshot_checked(self, hg, timers, copy_rows=False):
+        snap = orig(self, hg, timers, copy_rows)
+        if snap is not None:
+            _assert_equiv(self, snap.win, hg)
+            seen_slots["max"] = max(
+                seen_slots["max"], len(set(np.asarray(snap.win.psi)))
+            )
+        return snap
+
+    ws.WindowState.snapshot = snapshot_checked
+    try:
+        h = _replay_through(
+            acc, events, peers,
+            peer_change_round=3, removed_peer=peers.peers[-1],
+        )
+    finally:
+        ws.WindowState.snapshot = orig
+
+    oracle = Hashgraph(InmemStore(100000))
+    oracle.init(peers)
+    oracle.store.set_peer_set(3, peers.with_removed_peer(peers.peers[-1]))
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        oracle.insert_event_and_run_consensus(e, set_wire_info=True)
+
+    assert acc.fallbacks == 0
+    assert seen_slots["max"] >= 2, "peer-set change never reached a window"
+    assert _blocks(h) == _blocks(oracle)
+
+
+def test_mesh_stale_generation_drop():
+    """Donation safety on the mesh path: a pipelined sharded sweep
+    launched from generation N whose readback lands after generation N+1
+    mutated the resident state is detected and DROPPED (accel_stale_drops),
+    the oracle carries the flush, and consensus matches the pure-oracle
+    replay."""
+    events, peers, _keys = _stream(n_peers=6, n_events=160, seed=7)
+    acc = TensorConsensus(sweep_events=3, async_compile=False,
+                          min_window=0, pipeline=True, batcher=False,
+                          resident=True, mesh=_mesh8())
+    h = Hashgraph(InmemStore(100000))
+    h.init(peers)
+    h.accel = acc
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        h.insert_event_and_run_consensus(e, set_wire_info=True)
+    if acc._inflight is None:
+        acc._last_snapshot_topo = -1
+        h._accel_pending = 1
+        h.run_consensus_sweep()
+    inf = acc._inflight
+    assert inf is not None, "no sweep in flight"
+    assert inf.done.wait(60.0)
+    # generation N+1 mutates the resident state before the apply
+    acc.window_state.mark_dirty("test-mutation")
+    h._accel_pending = 1
+    h.run_consensus_sweep()
+    assert acc.stale_drops >= 1, "stale readback was not detected"
+    # drain whatever is still pipelined, then flush through the oracle
+    for _ in range(10):
+        h.flush_consensus()
+        if acc._inflight is None:
+            break
+
+    oracle = Hashgraph(InmemStore(100000))
+    oracle.init(peers)
+    for ev in events:
+        e = Event(ev.body, ev.signature)
+        e.prevalidate(True)
+        oracle.insert_event_and_run_consensus(e, set_wire_info=True)
+
+    assert _blocks(h) == _blocks(oracle)
+
+
+def test_copro_two_validators_share_one_mesh():
+    """Two validators with DIFFERENT peer sets and DAGs multiplex their
+    sweep windows through one shared mesh via the batcher coprocessor:
+    both converge to their own oracle's blocks, and the batcher accounts
+    both owners through the mesh lane."""
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+
+    mesh = _mesh8()
+    ev1, p1, _ = _stream(n_peers=6, n_events=160, seed=3)
+    ev2, p2, _ = _stream(n_peers=5, n_events=160, seed=11)
+
+    base_windows = SweepBatcher.instance().stats()["copro_windows"]
+    a1 = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                         pipeline=False, batcher=True, resident=False,
+                         mesh=mesh, owner="val-1")
+    a2 = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                         pipeline=False, batcher=True, resident=False,
+                         mesh=mesh, owner="val-2")
+    h1 = _replay_through(a1, ev1, p1)
+    h2 = _replay_through(a2, ev2, p2)
+
+    for events, peers, h in ((ev1, p1, h1), (ev2, p2, h2)):
+        oracle = Hashgraph(InmemStore(100000))
+        oracle.init(peers)
+        for ev in events:
+            e = Event(ev.body, ev.signature)
+            e.prevalidate(True)
+            oracle.insert_event_and_run_consensus(e, set_wire_info=True)
+        assert _blocks(h) == _blocks(oracle)
+
+    s = SweepBatcher.instance().stats()
+    assert s["copro_windows"] > base_windows, "mesh lane never dispatched"
+    assert s["copro_validators"] >= 2
+    assert a1.fallbacks == 0 and a2.fallbacks == 0
+
+
+def test_copro_wave_multiplexes_concurrent_windows():
+    """Windows submitted concurrently land in ONE coprocessor wave (shared
+    compile cache, one padded bucket) and each reads back its own
+    decisions — equal to its own single-device sweep."""
+    import threading
+
+    from babble_tpu.hashgraph.sweep_batcher import SweepBatcher
+    from babble_tpu.parallel.voting_shard import synthetic_voting_window
+
+    mesh = _mesh8()
+    _h1, win1 = synthetic_voting_window(n_peers=6, n_events=160, seed=3)
+    _h2, win2 = synthetic_voting_window(n_peers=5, n_events=128, seed=11)
+    want1 = voting.run_sweep(win1)
+    want2 = voting.run_sweep(win2)
+
+    svc = SweepBatcher.instance()
+    tickets = [None, None]
+    barrier = threading.Barrier(2)
+
+    def submit(i, win, owner):
+        barrier.wait()
+        tickets[i] = svc.submit(win, mesh=mesh, owner=owner)
+
+    threads = [
+        threading.Thread(target=submit, args=(0, win1, "copro-a")),
+        threading.Thread(target=submit, args=(1, win2, "copro-b")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tickets[0] is not None and tickets[1] is not None
+    assert tickets[0].done.wait(120.0) and tickets[1].done.wait(120.0)
+    assert tickets[0].error is None, tickets[0].error
+    assert tickets[1].error is None, tickets[1].error
+
+    for tkt, (fame_want, rr_want), win in (
+        (tickets[0], want1, win1),
+        (tickets[1], want2, win2),
+    ):
+        fame_got, rr_got = tkt.result
+        np.testing.assert_array_equal(
+            np.asarray(fame_got), np.asarray(fame_want)
+        )
+        np.testing.assert_array_equal(np.asarray(rr_got), np.asarray(rr_want))
+        assert len(np.asarray(fame_got)) == win.n_witnesses
+        assert len(np.asarray(rr_got)) == win.n_events
+    # both riders shared one wave (the barrier landed them in the same
+    # coalesce window) — or at minimum both cleared the mesh lane
+    assert tickets[0].batch_size + tickets[1].batch_size >= 2
+
+
+def test_mesh_pad_rows_counted_and_sharded():
+    """Satellite: an unaligned witness axis is PADDED to the mesh (counted
+    in accel_mesh_pad_rows), not silently dropped to single-device; the
+    padded window's decisions equal the original's."""
+    from babble_tpu.parallel.voting_shard import (
+        run_sharded_sweep,
+        synthetic_voting_window,
+    )
+
+    mesh = _mesh8()
+    _h, win = synthetic_voting_window(n_peers=6, n_events=160, seed=3)
+    key = voting.bucket_key(win)
+    # a W=20 bucket: multiple of 4, NOT of 8 — the mesh cannot shard it
+    # without padding
+    assert key[0] % 8 == 0
+    odd = voting.repad_window(win, (20 if key[0] <= 20 else key[0] + 4,)
+                              + key[1:])
+    assert odd.n_witnesses % 8 != 0
+
+    acc = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                          pipeline=False, batcher=False, resident=False,
+                          mesh=mesh)
+    aligned = acc._mesh_align(odd)
+    assert aligned.n_witnesses % 8 == 0
+    assert acc.mesh_pad_rows == aligned.n_witnesses - odd.n_witnesses
+    assert acc.mesh_fallbacks == 0
+    assert acc._use_mesh(aligned) and not acc._use_mesh(odd)
+
+    fame_ref, rr_ref = voting.run_sweep(win)
+    fame_sh, rr_sh = run_sharded_sweep(mesh, aligned)
+    # real rows keep prefix indexes under repad: slice back
+    np.testing.assert_array_equal(
+        np.asarray(fame_sh)[: win.n_witnesses], np.asarray(fame_ref)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rr_sh)[: win.n_events], np.asarray(rr_ref)
+    )
+
+
+def test_mesh_align_odd_mesh_counts_fallback():
+    """A mesh whose size has an odd factor can never divide a doubled
+    power-of-two W bucket: _mesh_align must give up (bounded climb),
+    count a fallback, and hand the window back unchanged."""
+    from types import SimpleNamespace
+
+    from babble_tpu.parallel.voting_shard import synthetic_voting_window
+
+    _h, win = synthetic_voting_window(n_peers=6, n_events=160, seed=3)
+    acc = TensorConsensus(sweep_events=8, async_compile=False, min_window=0,
+                          pipeline=False, batcher=False, resident=False)
+    acc.mesh = SimpleNamespace(devices=np.zeros(6))
+    out = acc._mesh_align(win)
+    assert out is win
+    assert acc.mesh_fallbacks == 1
+    assert acc.mesh_pad_rows == 0
