@@ -46,6 +46,7 @@ CLI_ONLY_DESTS = {
     "inmem_dummy",
     "no_adaptive",
     "no_gossip_pipeline",
+    "no_prune_vacuum",
 }
 
 KNOB_START = "<!-- knob-table-start -->"

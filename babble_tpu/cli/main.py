@@ -87,6 +87,10 @@ _RUN_FLAGS = {
     "signal_addr": ("signal_addr", str),
     "signal_ca": ("signal_ca", str),
     "signal_direct": ("signal_direct", str),
+    "prune_every_rounds": ("prune_every_rounds", int),
+    "prune_keep_rounds": ("prune_keep_rounds", int),
+    # lint: allow(knobs: toml-only; the CLI route is the negative-polarity --no-prune-vacuum)
+    "prune_vacuum": ("prune_vacuum", bool),
 }
 
 
@@ -123,6 +127,8 @@ def _build_config(args: argparse.Namespace) -> Config:
         layered["adaptive_gossip"] = False
     if getattr(args, "no_gossip_pipeline", False):
         layered["gossip_pipeline"] = False
+    if getattr(args, "no_prune_vacuum", False):
+        layered["prune_vacuum"] = False
     return Config(**layered)
 
 
@@ -479,6 +485,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--signal-direct", dest="signal_direct", default=None,
         help="direct p2p upgrade listen addr for signal mode (e.g. "
         "0.0.0.0:0); gossip then leaves the relay after the handshake",
+    )
+    run.add_argument(
+        "--prune-every-rounds", dest="prune_every_rounds", type=int,
+        default=None,
+        help="checkpoint-prune cadence: compact the store every N "
+        "committed rounds past the last prune floor (0 disables; "
+        "docs/lifecycle.md)",
+    )
+    run.add_argument(
+        "--prune-keep-rounds", dest="prune_keep_rounds", type=int,
+        default=None,
+        help="straggler margin: retain this many rounds below the "
+        "anchor when pruning",
+    )
+    run.add_argument(
+        "--no-prune-vacuum", dest="no_prune_vacuum", action="store_true",
+        help="skip the incremental SQLite vacuum after each prune "
+        "(pages are still reused, just not returned to the OS)",
     )
     run.add_argument(
         "--proxy-listen", dest="proxy_listen", default="127.0.0.1:1338",

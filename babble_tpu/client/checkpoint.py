@@ -12,9 +12,16 @@ Schema (all bytes b64, JSON-plain):
 
     {"format": "babble-checkpoint/1",
      "block":  <Block.to_dict()>,       # body + accumulated signatures
-     "frame":  <Frame.to_dict()>}       # peer-set history + roots
+     "frame":  <Frame.to_dict()>,       # peer-set history + roots
+     "snapshot": <hex>}                 # optional app snapshot at the
+                                        # anchor (validator rejoin only)
 
-Verification lives in ``client.verifier.verify_checkpoint``.
+Verification lives in ``client.verifier.verify_checkpoint`` (extra keys
+like ``snapshot`` are ignored — replicas don't need app state). The
+snapshot rides along for REJOINING VALIDATORS (docs/lifecycle.md): the
+reference ships it in FastForwardResponse, and a rejoiner that skips
+``proxy.restore`` would chain its app state hash from a stale prefix and
+commit blocks its peers refuse to countersign.
 """
 
 from __future__ import annotations
@@ -25,12 +32,15 @@ from ..crypto.canonical import jsonable
 from .verifier import CHECKPOINT_FORMAT, verify_checkpoint  # noqa: F401
 
 
-def make_checkpoint(block, frame) -> dict:
-    return {
+def make_checkpoint(block, frame, snapshot: bytes = None) -> dict:
+    cp = {
         "format": CHECKPOINT_FORMAT,
         "block": jsonable(block.to_dict()),
         "frame": jsonable(frame.to_dict()),
     }
+    if snapshot is not None:
+        cp["snapshot"] = snapshot.hex()
+    return cp
 
 
 def export_checkpoint(core) -> dict:

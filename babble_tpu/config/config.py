@@ -39,6 +39,13 @@ DEFAULT_WATCHDOG_INTERVAL_S = 1.0
 # whole cluster; BABBLE_OBS=0 disables regardless.
 DEFAULT_PROFILE_HZ = 50.0
 
+# Lifecycle tier defaults (docs/lifecycle.md) — single source of truth,
+# shared by the Config fields below and CheckpointPruner so a pruner
+# built outside a node can't drift from the configured cadence.
+DEFAULT_PRUNE_EVERY_ROUNDS = 0  # 0 = compaction off (append-only store)
+DEFAULT_PRUNE_KEEP_ROUNDS = 2
+DEFAULT_PRUNE_VACUUM = True
+
 
 def default_data_dir() -> str:
     """~/.babble equivalent (reference: config/config.go:287-297)."""
@@ -192,6 +199,16 @@ class Config:
     # surface (service or client_listen).
     txindex_cap: int = 1 << 18
 
+    # Lifecycle tier (docs/lifecycle.md): checkpoint-prune compaction.
+    # Every prune_every_rounds of anchor advance, the node seals its
+    # anchor checkpoint and compacts events/rounds/frames below
+    # (anchor - prune_keep_rounds) out of the store; prune_vacuum hands
+    # the freed SQLite pages back to the OS after each prune. 0 keeps
+    # the store append-only (the reference's behavior).
+    prune_every_rounds: int = DEFAULT_PRUNE_EVERY_ROUNDS
+    prune_keep_rounds: int = DEFAULT_PRUNE_KEEP_ROUNDS
+    prune_vacuum: bool = DEFAULT_PRUNE_VACUUM
+
     enable_fast_sync: bool = False
     store: bool = False  # persistent store (SQLite-backed) vs in-memory
     database_dir: str = ""
@@ -261,6 +278,10 @@ class Config:
             raise ValueError(
                 f"mempool_overflow must be 'reject' or 'evict-oldest', "
                 f"got {self.mempool_overflow!r}"
+            )
+        if self.prune_every_rounds < 0 or self.prune_keep_rounds < 0:
+            raise ValueError(
+                "prune_every_rounds and prune_keep_rounds must be >= 0"
             )
 
     def seeded_rng(self, stream: str, ident) -> object:

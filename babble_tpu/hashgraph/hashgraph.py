@@ -142,6 +142,13 @@ class Hashgraph:
         self.first_consensus_round: Optional[int] = None
         self.anchor_block: Optional[int] = None
         self.round_lower_bound: Optional[int] = None  # fast-sync boundary
+        # Checkpoint-prune retention floor (lifecycle tier): rounds below
+        # it have been compacted out of the store. None = never pruned.
+        self.prune_floor: Optional[int] = None
+        # Lowest round the next prune pass needs to re-examine — rounds
+        # below it were either dropped or fell below a previous floor
+        # with every created event already gone.
+        self._prune_scan_base = 0
         self.last_committed_round_events = 0
         self.consensus_transactions = 0
         self.pending_loaded_events = 0
@@ -337,6 +344,12 @@ class Hashgraph:
         """Parent round, +1 if x strongly sees a super-majority of
         parent-round witnesses (reference: hashgraph.go:220-282)."""
         ex = self.store.get_event(x)
+        if ex.round is not None:
+            # Already assigned (divide_rounds / frame insert / annotated
+            # reload) — rounds are write-once, so this is the value the
+            # recursion would rebuild, and it keeps the walk from
+            # descending into parents compaction may have dropped.
+            return ex.round
 
         parent_round = -1
         if ex.self_parent() != "":
@@ -401,6 +414,9 @@ class Hashgraph:
         """max(parents' timestamps) + 1; an unknown other-parent contributes
         nothing (reference: hashgraph.go:355-387)."""
         ex = self.store.get_event(x)
+        if ex.lamport_timestamp is not None:
+            # Write-once, same rationale as _round's short-circuit.
+            return ex.lamport_timestamp
         plt = -1
         if ex.self_parent() != "":
             plt = self.lamport_timestamp(ex.self_parent())
@@ -948,6 +964,13 @@ class Hashgraph:
             for i in range(r + 1, last_round + 1):
                 entry = round_entry(i)
                 if entry is None:
+                    if lb is not None and i <= lb:
+                        # Compacted round at/below the prune / fast-sync
+                        # floor: it is decided and its famous witnesses
+                        # are fixed, so it can never receive x — skip
+                        # upward exactly as the un-pruned oracle's
+                        # decided-round walk does.
+                        continue
                     # A joiner's first event can have round 0 while others
                     # have long evicted round 1 (reference:
                     # hashgraph.go:1019-1026).
@@ -1170,6 +1193,113 @@ class Hashgraph:
         block = self.store.get_block(self.anchor_block)
         frame = self.get_frame(block.round_received())
         return block, frame
+
+    # =========================================================================
+    # Compaction (lifecycle tier — babble_tpu/lifecycle/pruner.py)
+    # =========================================================================
+
+    def prune_below(self, floor_round: int) -> Dict[str, int]:
+        """Compact history below a sealed anchor: drop events received in
+        rounds < floor_round, rounds whose created events are all gone,
+        and frames below the floor — from cache AND durable storage.
+
+        Safe because everything at stake is final: rounds below the
+        anchor are decided, a decided round's famous witnesses are fixed
+        at decision time, and see() only consults coordinates frozen at
+        insert — so no event inserted after the prune can ever be
+        received at a pruned round, and the live pipeline never reads
+        below the floor.  What must survive does: every round ≥ the
+        floor and its frame, each participant's last ROOT_DEPTH+1
+        consensus events (future _create_root walks), any round below
+        the floor that still holds a live created event (its RoundInfo
+        backs _create_frame_event for straggler roots), and blocks /
+        peer-sets / roots / evidence / consensus counters wholesale.
+        """
+        if (
+            self.last_consensus_round is None
+            or floor_round > self.last_consensus_round
+        ):
+            raise ValueError(
+                f"prune floor {floor_round} beyond last consensus round "
+                f"{self.last_consensus_round}"
+            )
+        prev = self.prune_floor
+        if prev is not None and floor_round <= prev:
+            return {"floor": prev, "events_pruned": 0, "rounds_pruned": 0}
+
+        # Per-participant keep floor: the last ROOT_DEPTH+1 events below
+        # each participant's latest consensus event stay, whatever round
+        # received them — _create_root walks that far down the index.
+        floors: Dict[str, int] = {}
+        for p in self.store.repertoire_by_pub_key():
+            last = self.store.last_consensus_event_from(p)
+            if last == "":
+                continue
+            try:
+                ev = self.store.get_event(last)
+            except StoreError:
+                continue
+            keep_from = ev.index() - ROOT_DEPTH
+            if keep_from > 0:
+                floors[p] = keep_from
+
+        # Enumerate the drop set from the received-event lists of rounds
+        # below the floor. A hash that no longer loads was compacted (or
+        # evicted) already — re-listing it only re-issues a no-op delete.
+        dropped: set = set()
+        drop_events: List[str] = []
+        scan_base = self._prune_scan_base
+        for r in range(scan_base, floor_round):
+            try:
+                ri = self.store.get_round(r)
+            except StoreError:
+                continue
+            for h in ri.received_events:
+                if h in dropped:
+                    continue
+                try:
+                    ev = self.store.get_event(h)
+                except StoreError:
+                    dropped.add(h)
+                    drop_events.append(h)
+                    continue
+                fl = floors.get(ev.creator())
+                if fl is None or ev.index() >= fl:
+                    continue
+                dropped.add(h)
+                drop_events.append(h)
+
+        # A round goes only when ALL its created events are gone: an
+        # event created below the floor but received above it (or still
+        # undetermined) keeps its round alive for _create_frame_event.
+        drop_rounds: List[int] = []
+        new_scan_base = floor_round
+        for r in range(scan_base, floor_round):
+            try:
+                ri = self.store.get_round(r)
+            except StoreError:
+                continue
+            if all(h in dropped for h in ri.created_events):
+                drop_rounds.append(r)
+                self._round_ctx.pop(r, None)
+            elif r < new_scan_base:
+                new_scan_base = r
+
+        self.store.prune_below(floor_round, drop_events, drop_rounds, floors)
+
+        self._prune_scan_base = new_scan_base
+        self.prune_floor = floor_round
+        # Same boundary fast-sync establishes: rounds at/below the floor
+        # are never re-queued for fame voting, and the round-received
+        # scan skips their gaps (_rr_scan).
+        if self.round_lower_bound is None or floor_round > self.round_lower_bound:
+            self.round_lower_bound = floor_round
+
+        return {
+            "floor": floor_round,
+            "events_pruned": len(drop_events),
+            "rounds_pruned": len(drop_rounds),
+        }
 
     # =========================================================================
     # Reset / bootstrap

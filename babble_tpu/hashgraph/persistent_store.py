@@ -60,6 +60,12 @@ class PersistentStore:
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db_lock = threading.Lock()
         with self._db_lock:
+            # Declared BEFORE the first table exists so a fresh DB gets
+            # incremental vacuum (checkpoint-prune frees pages back to the
+            # OS without a full rebuild). On a pre-existing DB this is a
+            # no-op until a full VACUUM — vacuum(incremental=False) covers
+            # that upgrade path.
+            self._db.execute("PRAGMA auto_vacuum=INCREMENTAL")
             self._db.executescript(_SCHEMA)
             self._db.execute("PRAGMA journal_mode=WAL")
             self._db.execute("PRAGMA synchronous=NORMAL")
@@ -205,6 +211,17 @@ class PersistentStore:
             "Body": PreNormalized(event.body.normalized()),
             "Signature": event.signature,
         }
+        # Consensus annotations (write-once once assigned) ride along so a
+        # cache-evicted event reloads with its round/lamport intact —
+        # after compaction the recursive recomputation may no longer have
+        # the parents to rebuild them from. Bootstrap replay strips them
+        # (topological_events) so the from-zero recompute stays pristine.
+        if event.round is not None:
+            d["Round"] = event.round
+        if event.lamport_timestamp is not None:
+            d["Lamport"] = event.lamport_timestamp
+        if event.round_received is not None:
+            d["RoundReceived"] = event.round_received
         with self._db_lock:
             if self._db is None:
                 raise StoreError(
@@ -340,7 +357,7 @@ class PersistentStore:
                 "SELECT data FROM events ORDER BY topo LIMIT ? OFFSET ?",
                 (count, skip),
             ).fetchall()
-        return [_event_from_json(r[0]) for r in rows]
+        return [_event_from_json(r[0], annotated=False) for r in rows]
 
     def db_peer_set(self, round: int) -> PeerSet:
         """The persisted peer-set registered at EXACTLY this round (raw DB
@@ -405,6 +422,79 @@ class PersistentStore:
             )
         self.set_frame(frame)
 
+    # -- compaction ----------------------------------------------------------
+
+    def prune_below(
+        self,
+        floor_round: int,
+        drop_events: List[str],
+        drop_rounds: List[int],
+        participant_floors: Dict[str, int],
+    ) -> None:
+        """Durable half of checkpoint-prune: delete the compacted rows.
+        Blocks, peer-sets, roots and evidence are never touched — evidence
+        in particular is NOT replay-derived state (see set_evidence) and
+        must survive compaction."""
+        self._inmem.prune_below(
+            floor_round, drop_events, drop_rounds, participant_floors
+        )
+        with self._db_lock:
+            if self._db is None:
+                raise StoreError(
+                    "PersistentStore", StoreErrorKind.CLOSED, "prune"
+                )
+            self._db.executemany(
+                "DELETE FROM events WHERE key = ?",
+                [(h,) for h in drop_events],
+            )
+            self._db.executemany(
+                "DELETE FROM rounds WHERE idx = ?",
+                [(r,) for r in drop_rounds],
+            )
+            self._db.execute(
+                "DELETE FROM frames WHERE round < ?", (floor_round,)
+            )
+            for participant, floor in participant_floors.items():
+                self._db.execute(
+                    "DELETE FROM participant_events "
+                    "WHERE participant = ? AND idx < ?",
+                    (participant, floor),
+                )
+            self._db.commit()
+
+    def vacuum(self, incremental: bool = True) -> None:
+        """Hand freed pages back to the OS. Incremental is cheap and the
+        default (the DB is created with auto_vacuum=INCREMENTAL); a full
+        VACUUM rebuild also upgrades DBs that predate that pragma."""
+        with self._db_lock:
+            if self._db is None:
+                return
+            if incremental:
+                self._db.execute("PRAGMA incremental_vacuum")
+            else:
+                self._db.execute("VACUUM")
+            self._db.commit()
+
+    def size_stats(self) -> Dict[str, int]:
+        stats = dict(self._inmem.size_stats())
+        with self._db_lock:
+            if self._db is None:
+                return stats
+            ev = self._db.execute("SELECT COUNT(*) FROM events").fetchone()[0]
+            rd = self._db.execute("SELECT COUNT(*) FROM rounds").fetchone()[0]
+            bl = self._db.execute("SELECT COUNT(*) FROM blocks").fetchone()[0]
+            fr = self._db.execute("SELECT COUNT(*) FROM frames").fetchone()[0]
+            page_count = self._db.execute("PRAGMA page_count").fetchone()[0]
+            page_size = self._db.execute("PRAGMA page_size").fetchone()[0]
+            freelist = self._db.execute("PRAGMA freelist_count").fetchone()[0]
+        stats["events"] = ev
+        stats["rounds"] = rd
+        stats["blocks"] = bl
+        stats["frames"] = fr
+        stats["store_bytes"] = page_count * page_size
+        stats["free_bytes"] = freelist * page_size
+        return stats
+
     def close(self) -> None:
         with self._db_lock:
             if self._db is None:
@@ -446,6 +536,14 @@ class PersistentStore:
             self._db.commit()
 
 
-def _event_from_json(data: str) -> Event:
+def _event_from_json(data: str, annotated: bool = True) -> Event:
     d = json.loads(data)
-    return Event(EventBody.from_dict(d["Body"]), signature=d["Signature"])
+    ev = Event(EventBody.from_dict(d["Body"]), signature=d["Signature"])
+    if annotated:
+        if d.get("Round") is not None:
+            ev.set_round(d["Round"])
+        if d.get("Lamport") is not None:
+            ev.set_lamport_timestamp(d["Lamport"])
+        if d.get("RoundReceived") is not None:
+            ev.set_round_received(d["RoundReceived"])
+    return ev

@@ -55,6 +55,17 @@ class Store(Protocol):
     def reset(self, frame: Frame) -> None: ...
     def close(self) -> None: ...
     def store_path(self) -> str: ...
+    # Compaction (lifecycle tier — babble_tpu/lifecycle): the hashgraph
+    # computes WHAT is safe to drop (Hashgraph.prune_below); the store
+    # only deletes it and reports its footprint.
+    def prune_below(
+        self,
+        floor_round: int,
+        drop_events: List[str],
+        drop_rounds: List[int],
+        participant_floors: Dict[str, int],
+    ) -> None: ...
+    def size_stats(self) -> Dict[str, int]: ...
     # Misbehavior evidence (equivocation proofs — node/sentry.py): a flat
     # key -> jsonable-dict ledger, durable on persistent stores.
     def set_evidence(self, key: str, data: dict) -> None: ...
@@ -257,6 +268,39 @@ class InmemStore:
         self.set_frame(frame)
         # evidence survives resets: a fast-forward must not amnesty an
         # equivocator
+
+    # -- compaction --------------------------------------------------------
+
+    def prune_below(
+        self,
+        floor_round: int,
+        drop_events: List[str],
+        drop_rounds: List[int],
+        participant_floors: Dict[str, int],
+    ) -> None:
+        """Drop compacted history (lifecycle tier). Blocks, peer-sets,
+        roots, evidence and the consensus counters always survive — only
+        the listed events/rounds and frames below the floor go. The
+        participant index is already a bounded rolling window, so
+        ``participant_floors`` only matters to durable stores."""
+        for h in drop_events:
+            self._event_cache.remove(h)
+        for r in drop_rounds:
+            self._round_cache.remove(r)
+        for fr in [k for k in self._frame_cache.keys() if k < floor_round]:
+            self._frame_cache.remove(fr)
+
+    def size_stats(self) -> Dict[str, int]:
+        """Retained-object counts + byte footprint (0 for a pure in-memory
+        store) — the lifecycle_* gauges and healthview columns read this."""
+        return {
+            "events": len(self._event_cache),
+            "rounds": len(self._round_cache),
+            "blocks": len(self._block_cache),
+            "frames": len(self._frame_cache),
+            "store_bytes": 0,
+            "free_bytes": 0,
+        }
 
     # -- evidence ----------------------------------------------------------
 

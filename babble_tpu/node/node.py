@@ -256,6 +256,25 @@ class Node(StateManager):
         self.proofs_served = 0
         self.proof_misses = 0
         self.checkpoint_exports = 0
+        # Lifecycle tier (docs/lifecycle.md): checkpoint-prune compaction,
+        # driven from the gossip/monologue tails (_maybe_prune). Off by
+        # default — prune_every_rounds=0 keeps the store append-only.
+        self.pruner = None
+        if conf.prune_every_rounds > 0:
+            from ..lifecycle.pruner import CheckpointPruner
+
+            self.pruner = CheckpointPruner(
+                every_rounds=conf.prune_every_rounds,
+                keep_rounds=conf.prune_keep_rounds,
+                vacuum=conf.prune_vacuum,
+            )
+        # /checkpoint requests rejected for falling below the prune floor
+        # (clients see the behind_retention slug, not a generic 404).
+        self.behind_retention_rejections = 0
+        # Store-footprint snapshot memo: size_stats on a persistent store
+        # runs COUNT(*) queries, so the stats surface re-reads it at most
+        # once a second.
+        self._size_stats_memo: Dict[str, object] = {"t": -1.0, "v": None}
         self.client_hub = None
         if conf.client_listen and self.clock is WALL:
             from ..client.subhub import SubscriptionHub
@@ -525,15 +544,67 @@ class Node(StateManager):
         self.proofs_served += 1
         return build_proof(block, loc[1])
 
-    def get_checkpoint(self) -> Dict[str, object]:
+    def get_checkpoint(
+        self, at_round: Optional[int] = None, with_snapshot: bool = False
+    ) -> Dict[str, object]:
         """Signed fast-sync checkpoint (GET /checkpoint): the anchor
-        block + its frame. Raises while no block is sealed yet."""
-        from ..client.checkpoint import export_checkpoint
+        block + its frame. Raises ValueError while no block is sealed
+        yet. ``at_round`` asks for coverage from a specific round: the
+        earliest sealed block received at-or-after it. Below the prune
+        floor that history is compacted away — BehindRetentionError,
+        served as the distinct ``behind_retention`` slug so clients
+        ratchet forward instead of retrying forever. ``with_snapshot``
+        embeds the app snapshot at the anchor so a REJOINING VALIDATOR
+        can proxy.restore before fast_forward (replicas don't need it;
+        reference ships the same payload in FastForwardResponse)."""
+        from ..client.checkpoint import make_checkpoint
+        from ..lifecycle.pruner import BehindRetentionError
 
         with self.core_lock:
-            cp = export_checkpoint(self.core)
+            floor = self.core.hg.prune_floor
+            if at_round is not None and floor is not None and at_round < floor:
+                self.behind_retention_rejections += 1
+                raise BehindRetentionError(requested=at_round, floor=floor)
+            if at_round is None:
+                block, frame = self.core.get_anchor_block_with_frame()
+            else:
+                block = self._sealed_block_at_round(at_round)
+                if block is None:
+                    raise ValueError(
+                        f"no sealed block at or after round {at_round}"
+                    )
+                frame = self.core.hg.get_frame(block.round_received())
+            snapshot = None
+            if with_snapshot:
+                snapshot = self.proxy.get_snapshot(block.index())
+            cp = make_checkpoint(block, frame, snapshot)
         self.checkpoint_exports += 1
         return cp
+
+    def _sealed_block_at_round(self, at_round: int):
+        """Earliest SEALED block with round_received >= at_round, or
+        None. Blocks are round-monotonic in index, so binary search for
+        the boundary, then walk forward past any not-yet-sealed blocks
+        (signatures accumulate for a round or two after commit)."""
+        store = self.core.hg.store
+        last = self.core.get_last_block_index()
+        if last < 0:
+            return None
+        lo, hi = 0, last
+        while lo < hi:
+            mid = (lo + hi) // 2
+            try:
+                if store.get_block(mid).round_received() < at_round:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            except Exception:  # noqa: BLE001 — evicted: search higher
+                lo = mid + 1
+        for index in range(lo, last + 1):
+            block = self.get_sealed_block(index)
+            if block is not None and block.round_received() >= at_round:
+                return block
+        return None
 
     def get_stats_snapshot(self) -> Dict[str, object]:
         """One TYPED stats snapshot (numbers stay numbers) — the single
@@ -628,6 +699,24 @@ class Node(StateManager):
         stats["client_proof_misses"] = self.proof_misses
         stats["client_txindex_entries"] = len(self.txindex)
         stats["client_checkpoint_exports"] = self.checkpoint_exports
+        # Lifecycle tier surface (docs/lifecycle.md): retention floor,
+        # prune counters, and the store's retained-size view — the
+        # lifecycle_* instruments and healthview columns read these.
+        hg_floor = self.core.hg.prune_floor
+        lcr = stats["last_consensus_round"]
+        stats["lifecycle_prune_floor"] = -1 if hg_floor is None else hg_floor
+        stats["lifecycle_prune_lag_rounds"] = max(
+            0, int(lcr) - max(hg_floor or 0, 0)
+        )
+        stats["lifecycle_prunes"] = 0 if self.pruner is None else self.pruner.prunes
+        stats["lifecycle_pruned_events"] = (
+            0 if self.pruner is None else self.pruner.events_pruned
+        )
+        stats["lifecycle_behind_retention"] = self.behind_retention_rejections
+        sz = self._store_size_stats()
+        stats["lifecycle_events_retained"] = sz.get("events", 0)
+        stats["lifecycle_rounds_retained"] = sz.get("rounds", 0)
+        stats["lifecycle_store_bytes"] = sz.get("store_bytes", 0)
         stats.update(self.core.peer_selector.stats())
         stats["sync_limit_truncations"] = self.sync_limit_truncations
         stats["sync_diff_truncations"] = self.sync_diff_truncations
@@ -691,6 +780,17 @@ class Node(StateManager):
         """reference: node.go:277-294 — the reference's stringly map,
         derived at the edge from the typed snapshot."""
         return {k: str(v) for k, v in self.get_stats_snapshot().items()}
+
+    def _store_size_stats(self) -> Dict[str, int]:
+        """Memoized store.size_stats() (≤1 read/second — the persistent
+        store's implementation runs COUNT(*) queries)."""
+        now = self.clock.monotonic()
+        memo = self._size_stats_memo
+        if memo["v"] is None or now - float(memo["t"]) >= 1.0:
+            size_stats = getattr(self.core.hg.store, "size_stats", None)
+            memo["v"] = size_stats() if size_stats is not None else {}
+            memo["t"] = now
+        return memo["v"]
 
     # -- background ---------------------------------------------------------
 
@@ -905,6 +1005,7 @@ class Node(StateManager):
                 self.core.drain_hot_mempool()
                 self.core.hg.flush_consensus()
                 self.core.process_sig_pool()
+        self._maybe_prune()
 
     def _gossip(self, peer: Peer) -> None:
         """Pull-push gossip round (reference: node.go:466-501).
@@ -943,6 +1044,25 @@ class Node(StateManager):
             # local error (the generic branch) isn't the peer's fault
             self.core.peer_selector.update_last(
                 peer.id, connected, penalize=transport_failure
+            )
+        self._maybe_prune()
+
+    def _maybe_prune(self) -> None:
+        """Checkpoint-prune hook (docs/lifecycle.md), run from the
+        gossip/monologue tails — NEVER from the commit listener, where
+        compaction would mutate the store mid process_decided_rounds.
+        The due() pre-check is lock-free; the prune itself re-evaluates
+        under the core lock."""
+        if self.pruner is None or not self.pruner.due(self.core):
+            return
+        with self.core_lock:
+            stats = self.pruner.prune(self.core)
+        if stats is not None:
+            self.logger.info(
+                "checkpoint-prune: floor=%d events=%d rounds=%d",
+                stats["floor"],
+                stats["events_pruned"],
+                stats["rounds_pruned"],
             )
 
     def _pull(self, peer: Peer) -> Dict[int, int]:

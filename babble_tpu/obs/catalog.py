@@ -292,6 +292,45 @@ CATALOG: Tuple[Instrument, ...] = (
         "client_checkpoint_exports_total", _C, (), "node",
         "GET /checkpoint fast-sync snapshots exported.",
     ),
+    # -- lifecycle tier (docs/lifecycle.md) ---------------------------------
+    Instrument(
+        "lifecycle_events_retained", _G, (), "node",
+        "Events currently held by the hashgraph store (post-compaction "
+        "retained set; the plateau signal of checkpoint-prune).",
+    ),
+    Instrument(
+        "lifecycle_rounds_retained", _G, (), "node",
+        "Rounds currently held by the hashgraph store.",
+    ),
+    Instrument(
+        "lifecycle_store_bytes", _G, (), "node",
+        "Durable store footprint in bytes (SQLite page_count x "
+        "page_size; 0 for a purely in-memory store).",
+    ),
+    Instrument(
+        "lifecycle_prune_floor_round", _G, (), "node",
+        "Retention floor: consensus history below this round has been "
+        "compacted away (-1 before the first prune).",
+    ),
+    Instrument(
+        "lifecycle_prune_lag_rounds", _G, (), "node",
+        "Rounds of committed history retained above the prune floor "
+        "(last_consensus_round - floor); grows unbounded when pruning "
+        "is off or stalled.",
+    ),
+    Instrument(
+        "lifecycle_prunes_total", _C, (), "node",
+        "Checkpoint-prune compactions completed.",
+    ),
+    Instrument(
+        "lifecycle_pruned_events_total", _C, (), "node",
+        "Events dropped by compaction, cumulative.",
+    ),
+    Instrument(
+        "lifecycle_behind_retention_total", _C, (), "node",
+        "/checkpoint requests refused with the behind_retention slug "
+        "(client asked for history below the prune floor).",
+    ),
     # -- causal tracing / flight recorder ----------------------------------
     Instrument(
         "trace_sampled_txs_total", _C, (), "node",

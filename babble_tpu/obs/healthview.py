@@ -199,6 +199,12 @@ def sample_from_stats(entry: dict) -> dict:
         "client_subscribers": num("client_subscribers"),
         "client_shed_subscribers_total": num("client_shed_subscribers"),
         "client_proofs_served_total": num("client_proofs_served"),
+        # lifecycle tier (docs/lifecycle.md): retained store footprint
+        "lifecycle_events_retained": num("lifecycle_events_retained"),
+        "lifecycle_rounds_retained": num("lifecycle_rounds_retained"),
+        "lifecycle_store_bytes": num("lifecycle_store_bytes"),
+        "lifecycle_prune_floor_round": num("lifecycle_prune_floor", -1.0),
+        "lifecycle_prune_lag_rounds": num("lifecycle_prune_lag_rounds"),
     }
     clat_p50_ms = stats.get("commit_latency_p50_ms")
     return {
@@ -296,6 +302,25 @@ def merge(samples0: List[Optional[dict]], samples1: List[Optional[dict]],
             "proofs_served": int(
                 _metric(s1, "client_proofs_served_total")
             ),
+            # lifecycle tier (docs/lifecycle.md): what each node still
+            # holds after checkpoint-prune compaction — a node whose
+            # retained set grows while its peers plateau has pruning
+            # off or stalled (watch prune_lag_rounds climb).
+            "store": {
+                "events_retained": int(
+                    _metric(s1, "lifecycle_events_retained")
+                ),
+                "rounds_retained": int(
+                    _metric(s1, "lifecycle_rounds_retained")
+                ),
+                "store_bytes": int(_metric(s1, "lifecycle_store_bytes")),
+                "prune_floor": int(
+                    _metric(s1, "lifecycle_prune_floor_round", -1.0)
+                ),
+                "prune_lag_rounds": int(
+                    _metric(s1, "lifecycle_prune_lag_rounds")
+                ),
+            },
             "quarantined_peers": int(
                 _metric(s1, "sentry_quarantined_peers")
             ),
@@ -436,7 +461,9 @@ def render(view: dict) -> str:
         ),
         f"{'node':<10} {'state':<10} {'round':>7} {'lag':>4} "
         f"{'rnd/s':>7} {'blk/s':>7} {'p50ms':>8} {'burn':>6} "
-        f"{'queues s/p/q/m':>16} {'subs':>5} {'shed':>4} {'quar':>4}  health",
+        f"{'queues s/p/q/m':>16} {'subs':>5} {'shed':>4} "
+        f"{'ev':>6} {'rnds':>5} {'storKB':>7} {'plag':>5} {'quar':>4}"
+        "  health",
     ]
     for n in view["nodes"]:
         if n.get("down"):
@@ -458,6 +485,10 @@ def render(view: dict) -> str:
             f"/{q['pipeline_queue']:.0f}/{q['mempool_pending']:>.0f}"
             f"{'':>4}{n.get('subscribers', 0):>5} "
             f"{n.get('shed_subscribers', 0):>4} "
+            f"{n.get('store', {}).get('events_retained', 0):>6} "
+            f"{n.get('store', {}).get('rounds_retained', 0):>5} "
+            f"{n.get('store', {}).get('store_bytes', 0) // 1024:>7} "
+            f"{n.get('store', {}).get('prune_lag_rounds', 0):>5} "
             f"{n['quarantined_peers']:>4}  "
             + ("ok" if n.get("healthy") else "UNHEALTHY")
         )

@@ -502,6 +502,51 @@ class NodeTelemetry:
             "client_checkpoint_exports_total",
             lambda: node.checkpoint_exports,
         )
+        # Lifecycle tier (docs/lifecycle.md): compaction progress and
+        # the retained store footprint. The size gauges share the
+        # node's 1s-TTL size_stats memo (COUNT(*) on a persistent
+        # store), so a scrape never runs the queries more than once.
+        self._func(
+            "lifecycle_events_retained",
+            lambda: node._store_size_stats().get("events", 0),
+        )
+        self._func(
+            "lifecycle_rounds_retained",
+            lambda: node._store_size_stats().get("rounds", 0),
+        )
+        self._func(
+            "lifecycle_store_bytes",
+            lambda: node._store_size_stats().get("store_bytes", 0),
+        )
+        self._func(
+            "lifecycle_prune_floor_round",
+            lambda: (
+                -1
+                if node.core.hg.prune_floor is None
+                else node.core.hg.prune_floor
+            ),
+        )
+
+        def _prune_lag():
+            lcr = node.core.get_last_consensus_round_index()
+            if lcr is None:
+                return 0
+            floor = node.core.hg.prune_floor or 0
+            return max(0, int(lcr) - max(floor, 0))
+
+        self._func("lifecycle_prune_lag_rounds", _prune_lag)
+        self._func(
+            "lifecycle_prunes_total",
+            lambda: node.pruner.prunes if node.pruner else 0,
+        )
+        self._func(
+            "lifecycle_pruned_events_total",
+            lambda: node.pruner.events_pruned if node.pruner else 0,
+        )
+        self._func(
+            "lifecycle_behind_retention_total",
+            lambda: node.behind_retention_rejections,
+        )
         self._func(
             "watchdog_trips_total",
             lambda: getattr(node.watchdog, "trips", 0),

@@ -116,9 +116,30 @@ class Service:
                     return
             elif path == "/checkpoint":
                 # signed fast-sync snapshot for read-replica spin-up
-                # (docs/clients.md §Checkpoints)
+                # (docs/clients.md §Checkpoints). ?round=N asks for the
+                # earliest sealed anchor at-or-after round N; below the
+                # prune floor the answer is the distinct behind_retention
+                # slug + the floor (410, not a generic 404), so clients
+                # ratchet forward instead of retrying (docs/lifecycle.md).
+                from ..lifecycle.pruner import BehindRetentionError
+
+                qs = parse_qs(parsed.query)
+                at_round = None
+                if "round" in qs:
+                    at_round = int(qs["round"][0])
                 try:
-                    body = self.node.get_checkpoint()
+                    # ?snapshot=1 embeds the app snapshot at the anchor
+                    # — a rejoining validator's one-request bootstrap
+                    body = self.node.get_checkpoint(
+                        at_round, with_snapshot="snapshot" in qs
+                    )
+                except BehindRetentionError as err:
+                    self._send(req, 410, {
+                        "error": "behind_retention",
+                        "requested": err.requested,
+                        "floor": err.floor,
+                    })
+                    return
                 except ValueError as err:
                     self._send(req, 404, {"error": str(err)})
                     return

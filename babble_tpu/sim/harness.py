@@ -169,6 +169,8 @@ class SimCluster:
         split: bool = False,
         trace_sample: Optional[float] = None,
         adaptive: bool = True,
+        store_factory: Optional[Callable[[int], object]] = None,
+        conf_extra: Optional[dict] = None,
     ):
         self.sch = sch
         self.network = SimNetwork()
@@ -192,8 +194,13 @@ class SimCluster:
         self.addrs = [sim_addr(i) for i in range(n)]
         self.n_honest = n_honest
 
+        # Per-node store override (the lifecycle plateau sims swap in a
+        # PersistentStore so prune/vacuum byte accounting is real).
+        if store_factory is None:
+            store_factory = lambda i: InmemStore(10000)  # noqa: E731
+
         def conf(i: int) -> Config:
-            kw = {}
+            kw = dict(conf_extra or {})
             if trace_sample is not None:
                 # provenance sampling override (the determinism tests
                 # trace every tx; stamps ride the SimClock, so same-seed
@@ -232,7 +239,7 @@ class SimCluster:
             proxy = InmemProxy(state)
             node = Node(
                 conf(i), Validator(keys[i], f"node{i}"), self.peers,
-                self.peers, InmemStore(10000), trans, proxy,
+                self.peers, store_factory(i), trans, proxy,
             )
             node.init()
             self.network.register(

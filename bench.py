@@ -18,6 +18,7 @@ Also measured and reported in the "extra" field:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import sys
@@ -1944,6 +1945,121 @@ def main_clients(smoke: bool = False) -> None:
     print(line)
 
 
+def bench_prune(smoke: bool = False) -> dict:
+    """Checkpoint-prune economics (docs/lifecycle.md): two same-seed
+    virtual-time arms — pruned vs un-pruned control — under sustained
+    load. Reports the retained-store footprint ratio, prune counters,
+    and the load-bearing invariant: byte-identical commit digests (the
+    pruned arm re-proves every run that compaction is an optimization,
+    never a consensus input)."""
+    from babble_tpu.sim.harness import SimCluster
+    from babble_tpu.sim.scheduler import SimScheduler
+
+    horizon = 30.0 if smoke else 120.0
+
+    def arm(prune: bool) -> dict:
+        sch = SimScheduler(seed=42)
+        extra = (
+            {"prune_every_rounds": 4, "prune_keep_rounds": 2}
+            if prune else {}
+        )
+        cl = SimCluster(sch, n_honest=4, conf_extra=extra)
+        cl.start()
+        rng = sch.rng("txgen")
+
+        def pump():
+            cl.submit_auto(rng)
+            sch.after(0.05, pump, "tx")
+
+        sch.after(0.05, pump, "tx")
+        t0 = time.monotonic()
+        try:
+            sch.run_until(horizon)
+            node = cl.nodes[0]
+            stats = node.get_stats()
+            # the pump never pauses, so nodes sample mid-commit at
+            # different tips — compare chains over the COMMON prefix
+            # (a straggler tip is pipeline lag, not disagreement)
+            common = min(
+                cl.nodes[i].get_last_block_index()
+                for i in range(len(cl.nodes))
+            )
+            chains = [
+                [
+                    cl.nodes[i].get_block(bi).body.hash().hex()
+                    for bi in range(common + 1)
+                ]
+                for i in range(len(cl.nodes))
+            ]
+            return {
+                "wall_s": round(time.monotonic() - t0, 3),
+                "rounds": int(stats["last_consensus_round"]),
+                "blocks": common + 1,
+                "events_retained": int(stats["lifecycle_events_retained"]),
+                "store_bytes": int(stats["lifecycle_store_bytes"]),
+                "prunes": node.pruner.prunes if node.pruner else 0,
+                "events_pruned": (
+                    node.pruner.events_pruned if node.pruner else 0
+                ),
+                "chain": chains[0],
+                "digests_agree": all(c == chains[0] for c in chains[1:]),
+            }
+        finally:
+            cl.shutdown()
+
+    pruned = arm(True)
+    control = arm(False)
+    retained_ratio = pruned["events_retained"] / max(
+        1, control["events_retained"]
+    )
+    depth = min(len(pruned["chain"]), len(control["chain"]))
+    digest_match = (
+        pruned["chain"][:depth] == control["chain"][:depth]
+        and pruned["digests_agree"]
+        and control["digests_agree"]
+    )
+    # the ledger keeps summaries, not chains
+    for a in (pruned, control):
+        a["digest"] = hashlib.sha256(
+            "".join(a.pop("chain")[:depth]).encode()
+        ).hexdigest()
+    return {
+        "virtual_horizon_s": horizon,
+        "pruned": pruned,
+        "control": control,
+        "retained_ratio": round(retained_ratio, 4),
+        "digest_compared_blocks": depth,
+        "digest_match": digest_match,
+    }
+
+
+def main_prune(smoke: bool = False) -> None:
+    """`make prunebench` / `bench.py --prune`: checkpoint-prune
+    footprint + digest-equality economics, detail on stderr and ONE
+    parseable JSON line on stdout (the tail-capture contract)."""
+    res = bench_prune(smoke=smoke)
+    p, c = res["pruned"], res["control"]
+    print(
+        f"prune: {p['rounds']} rounds, {p['blocks']} blocks; retained "
+        f"{p['events_retained']} vs control {c['events_retained']} "
+        f"events (ratio {res['retained_ratio']}), "
+        f"{p['prunes']} prunes dropping {p['events_pruned']} events, "
+        f"digest_match={res['digest_match']}, "
+        f"wall {p['wall_s']}s vs {c['wall_s']}s",
+        file=sys.stderr,
+    )
+    assert res["digest_match"], res
+    assert p["prunes"] > 0, res
+    assert p["events_retained"] < c["events_retained"], res
+    _ledger_append("prune_smoke" if smoke else "prune", res)
+    line = json.dumps(
+        {"bench_summary": "prune_smoke" if smoke else "prune", **res},
+        separators=(",", ":"),
+    )
+    assert len(line) < 2000, "prune summary exceeded tail-capture budget"
+    print(line)
+
+
 def main_obs(smoke: bool = False) -> None:
     """`make obssmoke` / `bench.py --obs`: the observability smoke,
     detail on stderr and ONE parseable JSON line on stdout."""
@@ -2977,6 +3093,8 @@ def main() -> None:
         return main_copro("--smoke" in sys.argv)
     if "--clients" in sys.argv:
         return main_clients("--smoke" in sys.argv)
+    if "--prune" in sys.argv:
+        return main_prune("--smoke" in sys.argv)
     if "--mempool" in sys.argv:
         return main_mempool("--smoke" in sys.argv)
     if "--obs" in sys.argv:

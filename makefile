@@ -173,6 +173,22 @@ clientsmoke:
 clientbench:
 	JAX_PLATFORMS=cpu python bench.py --clients --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['sub_blocks_received'] > 0, d; assert d['sub_gaps'] == 0, d; assert d['proof_verify_ok'], d; print('clientbench ok:', d['fanout_blocks_per_s'], 'pushed blocks/s to', d['subscribers'], 'subs, proof p50', d['proof_latency_p50_ms'], 'ms')"
 
+# prunesmoke: lifecycle tier end to end (docs/lifecycle.md) — pruned-vs-
+# oracle digest equality in virtual time, the rotation/rejoin-from-
+# pruned-checkpoint sim, the behind_retention HTTP slug, evidence
+# surviving compaction, SQLite shrink+vacuum mechanics, and a LIVE
+# 4-validator cluster where every node prunes mid-traffic, one rotates
+# out through consensus, and a fresh validator joins by fast-syncing
+# from peers that have all compacted their history.
+prunesmoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -q -m "not slow"
+
+# prunebench: checkpoint-prune economics — retained-footprint ratio vs
+# an un-pruned same-seed control arm, with the digest-equality invariant
+# re-proven; ledger-recorded so perfgate bands regressions
+prunebench:
+	JAX_PLATFORMS=cpu python bench.py --prune --smoke | tail -n 1 | python -c "import json,sys; d=json.loads(sys.stdin.read().strip()); assert d['digest_match'], d; assert d['pruned']['prunes'] > 0, d; print('prunebench ok:', d['pruned']['rounds'], 'rounds,', d['pruned']['events_retained'], 'vs', d['control']['events_retained'], 'events retained (ratio', str(d['retained_ratio']) + '),', d['pruned']['prunes'], 'prunes')"
+
 # killtestnet: reap stray demo/testnet.py processes from an aborted run
 # — they squat the demo ports and poison later perfgate baselines. The
 # well-known pidfile covers even a SIGKILLed driver; each recorded PID
@@ -209,4 +225,4 @@ simsweep:
 wheel:
 	python -m pip wheel . --no-deps -w dist
 
-.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke coprosmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint staticcheck perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench killtestnet simsmoke simsweep wheel
+.PHONY: native tests test flagtest extratests alltests dryrun bench benchsmoke benchdag benchdagsmoke coprosmoke mempoolsmoke chaossmoke chaossoak byzsmoke byzstorm obssmoke metricslint staticcheck perfgate healthsmoke tracesmoke gossipsmoke adaptsmoke clientsmoke clientbench prunesmoke prunebench killtestnet simsmoke simsweep wheel

@@ -77,6 +77,13 @@ def pytest_configure(config):
         "inclusion proofs, checkpointed replicas, sharded gateway; "
         "make clientsmoke — docs/clients.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lifecycle: checkpoint-prune compaction + elastic membership "
+        "(pruned-vs-oracle digest equality, retention plateau, "
+        "rotation/rejoin from pruned checkpoints; make prunesmoke — "
+        "docs/lifecycle.md)",
+    )
 
 
 def setup_testnet_datadirs(tmp_path, n: int, base_port: int,
